@@ -1,0 +1,10 @@
+"""goworld_tpu -- a TPU-native distributed game-server framework.
+
+A ground-up re-design of the capabilities of GoWorld (studied at
+/root/reference; see SURVEY.md) for TPU: the per-Space AOI (area-of-interest)
+visibility pass runs as a batched JAX/Pallas kernel with Spaces sharded over
+chips, while the entity runtime, dispatcher fabric, gates, persistence and ops
+tooling are host-side components mirroring the reference's architecture.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,48 @@
+"""Dense JAX backend for the AOI visibility pass (pure jnp, no Pallas).
+
+Evaluates the exact predicate of :mod:`aoi_predicate` on [C] position arrays,
+packs the interest matrix into planar uint32 words, and XOR-diffs against the
+previous tick.  This is the readable reference implementation the Pallas
+kernel (:mod:`aoi_pallas`) is checked against; it is also a perfectly good
+execution path on its own for capacities where XLA's fusion handles the [C, C]
+intermediate well.
+
+All functions are shape-polymorphic over leading batch (space) dimensions only
+via ``jax.vmap``; the core operates on a single space.
+
+Reference seam: /root/reference/engine/entity/Space.go:253-261 (Moved ->
+AOI recompute) batched per tick per the north-star design.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .aoi_predicate import WORD_BITS, words_per_row
+
+
+def interest_words_dense(x, z, radius, active):
+    """Predicate over all pairs, packed.  [C] f32 inputs -> [C, W] uint32."""
+    c = x.shape[0]
+    w = words_per_row(c)
+    dx = jnp.abs(x[None, :] - x[:, None])
+    dz = jnp.abs(z[None, :] - z[:, None])
+    r = radius[:, None]
+    m = (dx <= r) & (dz <= r)
+    m &= active[:, None] & active[None, :]
+    m &= ~jnp.eye(c, dtype=bool)
+    planes = m.reshape(c, WORD_BITS, w).astype(jnp.uint32)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)[None, :, None]
+    return jnp.sum(planes << shifts, axis=1, dtype=jnp.uint32)
+
+
+def aoi_step_dense(x, z, radius, active, prev_words):
+    """One tick: returns (new_words, enter_words, leave_words), all [C, W]."""
+    new_words = interest_words_dense(x, z, radius, active)
+    enter = new_words & ~prev_words
+    leave = prev_words & ~new_words
+    return new_words, enter, leave
+
+
+aoi_step_dense_batched = jax.vmap(aoi_step_dense)  # [S, C] / [S, C, W]

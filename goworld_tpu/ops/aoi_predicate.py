@@ -1,0 +1,123 @@
+"""The AOI interest predicate and packed-bitmask layout.
+
+This module is the single source of truth shared by every AOI backend (CPU
+oracle, dense JAX, Pallas kernel).  Bit-exact enter/leave parity between
+backends is only possible if they all evaluate the *same* predicate with the
+*same* rounding, so the predicate is defined once, here, and deliberately uses
+only IEEE-754 operations that are exactly rounded in float32 on every backend
+(subtraction, abs, compare) -- no squared distances, no FMA hazards.
+
+Predicate (square-range / Chebyshev interest, per-entity radius):
+
+    interested(A, B) :=  A != B
+                     and active(A) and active(B)
+                     and |x_B - x_A| <= r_A   (float32)
+                     and |z_B - z_A| <= r_A   (float32)
+
+Ties (|d| == r exactly) count as interested.  Interest is asymmetric: radii
+differ per entity, so A-interested-in-B does not imply B-interested-in-A.
+
+This matches the coordinate-window semantics of the reference's XZ-sorted-list
+AOI manager (`go-aoi` XZList, used at /root/reference/engine/entity/Space.go:105
+via NewXZListAOIManager): each of the sorted-by-x and sorted-by-z lists defines
+a +-dist window and an entity is a neighbor iff it lies in both windows.
+
+Packed-bitmask layout ("planar"):
+
+    Interest of all N entities in all C (capacity) entities is a boolean
+    matrix M[N, C].  It is packed into uint32 words[N, W] with W = C // 32,
+    where bit k of words[i, w] == M[i, k * W + w].
+
+    i.e. bit-plane k is the contiguous column slice M[:, k*W:(k+1)*W].
+
+The planar layout is chosen for the TPU kernel: packing is 32 shift-or steps
+over *contiguous* [rows, W] column slices (lane-aligned, no strided access),
+instead of a gather over stride-32 columns.  The CPU side only ever touches the
+layout through pack/unpack/pairs helpers below, so the choice is invisible to
+callers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+WORD_BITS = 32
+
+# Capacities must be a multiple of LANE (TPU lane width) so W is a multiple of
+# 4 and every kernel block is lane-aligned.
+LANE = 128
+
+
+def round_capacity(n: int) -> int:
+    """Smallest valid space capacity >= n (multiple of LANE, min LANE)."""
+    return max(LANE, -(-n // LANE) * LANE)
+
+
+def words_per_row(capacity: int) -> int:
+    if capacity % LANE != 0:
+        raise ValueError(f"capacity {capacity} not a multiple of {LANE}")
+    return capacity // WORD_BITS
+
+
+def interest_matrix(
+    x: np.ndarray,
+    z: np.ndarray,
+    radius: np.ndarray,
+    active: np.ndarray,
+) -> np.ndarray:
+    """Reference (numpy, O(N^2)) evaluation of the predicate.
+
+    Args are 1-D float32/bool arrays of length C (the space capacity; padded
+    slots have active=False).  Returns bool matrix M[C, C] where M[i, j] means
+    entity i is interested in entity j.
+    """
+    x = np.asarray(x, np.float32)
+    z = np.asarray(z, np.float32)
+    radius = np.asarray(radius, np.float32)
+    active = np.asarray(active, bool)
+    dx = np.abs(x[None, :] - x[:, None])  # f32, exactly rounded
+    dz = np.abs(z[None, :] - z[:, None])
+    r = radius[:, None]
+    m = (dx <= r) & (dz <= r)
+    m &= active[:, None] & active[None, :]
+    np.fill_diagonal(m, False)
+    return m
+
+
+def pack_rows(m: np.ndarray) -> np.ndarray:
+    """Pack bool matrix [N, C] -> uint32 words [N, W] (planar layout)."""
+    n, c = m.shape
+    w = words_per_row(c)
+    planes = m.reshape(n, WORD_BITS, w).astype(np.uint32)
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)[None, :, None]
+    return (planes << shifts).sum(axis=1, dtype=np.uint32)
+
+
+def unpack_rows(words: np.ndarray, capacity: int) -> np.ndarray:
+    """Inverse of pack_rows: uint32 [N, W] -> bool [N, capacity]."""
+    n, w = words.shape
+    if w != words_per_row(capacity):
+        raise ValueError(f"words width {w} != {words_per_row(capacity)}")
+    shifts = np.arange(WORD_BITS, dtype=np.uint32)[None, :, None]
+    planes = (words[:, None, :] >> shifts) & np.uint32(1)
+    return planes.reshape(n, capacity).astype(bool)
+
+
+def word_bit_for_column(j: int, capacity: int) -> tuple[int, int]:
+    """(word index, bit index) holding column j in the planar layout."""
+    w = words_per_row(capacity)
+    return j % w, j // w
+
+
+def pairs_from_words(words: np.ndarray, capacity: int) -> np.ndarray:
+    """Extract (i, j) index pairs of set bits from packed words, sorted
+    lexicographically by (i, j).  Returns int32 array [n_pairs, 2]."""
+    m = unpack_rows(np.asarray(words), capacity)
+    i, j = np.nonzero(m)
+    out = np.stack([i, j], axis=1).astype(np.int32)
+    return out  # np.nonzero is already row-major sorted
+
+
+def pairs_from_matrix(m: np.ndarray) -> np.ndarray:
+    i, j = np.nonzero(m)
+    return np.stack([i, j], axis=1).astype(np.int32)

@@ -1,0 +1,46 @@
+"""Device-side extraction of enter/leave event pairs from packed diff words.
+
+A batched AOI tick produces *sets* of events as packed bitmasks; the host
+needs (observer, observed) index pairs to replay the entity callbacks
+(onEnterAOI/onLeaveAOI -- reference /root/reference/engine/entity/Entity.go:227-233).
+Shipping full [C, W] masks D2H every tick is wasteful at scale, so events are
+compacted on device into fixed-capacity index lists (static shapes under jit).
+
+``extract_pairs(words, capacity, max_events)`` returns:
+  * pairs [max_events, 2] int32, (-1, -1)-filled past the real events,
+    sorted lexicographically by (observer, observed) -- the deterministic
+    callback replay order;
+  * count: the true number of set bits (may exceed max_events; the caller
+    detects overflow with count > max_events and falls back to host-side
+    unpacking of the mask for that rare tick).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .aoi_predicate import WORD_BITS, words_per_row
+
+
+def popcount_total(words) -> jnp.ndarray:
+    """Total set bits in a packed words array (any shape)."""
+    return jnp.sum(jax.lax.population_count(words), dtype=jnp.int32)
+
+
+def unpack_words(words, capacity: int):
+    """uint32 [N, W] -> bool [N, capacity] (planar layout)."""
+    n, w = words.shape
+    assert w == words_per_row(capacity)
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)[None, :, None]
+    planes = (words[:, None, :] >> shifts) & jnp.uint32(1)
+    return planes.reshape(n, capacity).astype(bool)
+
+
+def extract_pairs(words, capacity: int, max_events: int):
+    """Packed diff words -> ((observer, observed) pairs, true count)."""
+    m = unpack_words(words, capacity)
+    count = popcount_total(words)
+    i, j = jnp.nonzero(m, size=max_events, fill_value=-1)
+    # jnp.nonzero on a row-major matrix is already (i, j)-lexicographic.
+    return jnp.stack([i, j], axis=1).astype(jnp.int32), count

@@ -1,0 +1,128 @@
+"""Bit-exact parity of AOI backends: CPU pairwise oracle vs CPU sweep vs
+dense JAX.  The scenarios deliberately include exact boundary ties (positions
+and radii on a lattice) and entities entering/leaving the space mid-run."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.ops import (
+    CPUAOIOracle,
+    aoi_step_dense,
+    extract_pairs,
+    interest_matrix,
+    pack_rows,
+    pairs_from_words,
+    round_capacity,
+    unpack_rows,
+    words_per_row,
+)
+
+
+def random_walk_scenario(seed, capacity, n_active, ticks, tie_lattice=False):
+    """Yields (x, z, r, active) per tick."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 400, capacity).astype(np.float32)
+    z = rng.uniform(0, 400, capacity).astype(np.float32)
+    r = rng.choice([25.0, 50.0, 100.0], capacity).astype(np.float32)
+    active = np.zeros(capacity, bool)
+    active[:n_active] = True
+    if tie_lattice:
+        # Positions on a 0.25 lattice with integer radii: |dx| == r happens
+        # often, exercising the tie rule.
+        x = (np.round(x * 4) / 4).astype(np.float32)
+        z = (np.round(z * 4) / 4).astype(np.float32)
+        r = np.round(r).astype(np.float32)
+    for _ in range(ticks):
+        yield x.copy(), z.copy(), r.copy(), active.copy()
+        step = rng.uniform(-5, 5, (2, capacity)).astype(np.float32)
+        if tie_lattice:
+            step = (np.round(step * 4) / 4).astype(np.float32)
+        x = (x + step[0]).astype(np.float32)
+        z = (z + step[1]).astype(np.float32)
+        flips = rng.random(capacity) < 0.02
+        active ^= flips
+        active[n_active:] &= rng.random(capacity - n_active) < 0.5
+
+
+def as_sets(pairs):
+    return {tuple(p) for p in np.asarray(pairs).tolist()}
+
+
+@pytest.mark.parametrize("tie_lattice", [False, True])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sweep_matches_pairwise(seed, tie_lattice):
+    cap = round_capacity(200)
+    a = CPUAOIOracle(cap, "pairwise")
+    b = CPUAOIOracle(cap, "sweep")
+    for x, z, r, act in random_walk_scenario(seed, cap, 180, 6, tie_lattice):
+        ea, la = a.step(x, z, r, act)
+        eb, lb = b.step(x, z, r, act)
+        np.testing.assert_array_equal(ea, eb)
+        np.testing.assert_array_equal(la, lb)
+
+
+@pytest.mark.parametrize("tie_lattice", [False, True])
+@pytest.mark.parametrize("seed", [0, 3])
+def test_dense_jax_matches_oracle(seed, tie_lattice):
+    import jax.numpy as jnp
+
+    cap = round_capacity(300)
+    w = words_per_row(cap)
+    oracle = CPUAOIOracle(cap, "pairwise")
+    prev = jnp.zeros((cap, w), jnp.uint32)
+    for x, z, r, act in random_walk_scenario(seed, cap, 6, 5, tie_lattice):
+        e_ref, l_ref = oracle.step(x, z, r, act)
+        new, ent, lv = aoi_step_dense(
+            jnp.asarray(x), jnp.asarray(z), jnp.asarray(r), jnp.asarray(act), prev
+        )
+        prev = new
+        np.testing.assert_array_equal(
+            np.asarray(new), oracle.prev_words, err_msg="interest words diverge"
+        )
+        np.testing.assert_array_equal(pairs_from_words(np.asarray(ent), cap), e_ref)
+        np.testing.assert_array_equal(pairs_from_words(np.asarray(lv), cap), l_ref)
+
+
+def test_extract_pairs_matches_host_unpack():
+    import jax.numpy as jnp
+
+    cap = round_capacity(256)
+    rng = np.random.default_rng(7)
+    m = rng.random((cap, cap)) < 0.001
+    words = pack_rows(m)
+    pairs, count = extract_pairs(jnp.asarray(words), cap, max_events=4096)
+    pairs = np.asarray(pairs)
+    n = int(count)
+    assert n == m.sum()
+    got = pairs[:n]
+    np.testing.assert_array_equal(got, pairs_from_words(words, cap))
+    assert (pairs[n:] == -1).all()
+
+
+def test_extract_pairs_overflow_reports_true_count():
+    import jax.numpy as jnp
+
+    cap = round_capacity(128)
+    m = np.ones((cap, cap), bool)
+    words = pack_rows(m)
+    _, count = extract_pairs(jnp.asarray(words), cap, max_events=16)
+    assert int(count) == cap * cap
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(0)
+    cap = round_capacity(500)
+    m = rng.random((cap, cap)) < 0.1
+    np.testing.assert_array_equal(unpack_rows(pack_rows(m), cap), m)
+
+
+def test_predicate_tie_and_asymmetry():
+    # B exactly on A's window corner -> tie counts as interested;
+    # B's radius smaller -> B not interested back (asymmetric).
+    x = np.array([0.0, 10.0], np.float32)
+    z = np.array([0.0, 10.0], np.float32)
+    r = np.array([10.0, 5.0], np.float32)
+    act = np.array([True, True])
+    m = interest_matrix(x, z, r, act)
+    assert m[0, 1] and not m[1, 0]
+    assert not m[0, 0] and not m[1, 1]
