@@ -1,0 +1,8 @@
+"""Host-side engine: entity/space runtime, AOI seam, attrs, timers, RPC.
+
+The engine mirrors the reference's single-logic-thread architecture
+(/root/reference/components/game/GameService.go:88-192): all entity logic runs
+on one thread; I/O and workers hand results back via the post queue.  The AOI
+visibility pass is the TPU-offloaded portion, reached through the calculator
+seam in :mod:`goworld_tpu.engine.aoi`.
+"""

@@ -1,0 +1,337 @@
+"""The AOI-calculator seam: where Spaces meet the TPU.
+
+Reference seam being re-designed (not ported): the reference plugs an
+``aoi.AOIManager{Enter,Leave,Moved}`` into each Space
+(/root/reference/engine/entity/Space.go:33,105,211,243,259) and receives
+synchronous OnEnterAOI/OnLeaveAOI callbacks per mutation
+(Entity.go:227-233).  Here the same contract is delivered *batched per tick*:
+
+    1. each Space stages its per-tick arrays (x, z, radius, active);
+    2. the game loop calls ``AOIEngine.flush()`` once per tick;
+    3. the engine executes one batched step per (backend, capacity) bucket --
+       on TPU that is ONE pallas kernel launch for every space of that
+       capacity on the chip -- and returns per-space enter/leave event pairs
+       in deterministic (observer, observed) order.
+
+Spaces shard over chips with no cross-chip collectives: a bucket's arrays are
+sharded over the mesh 'space' axis (see goworld_tpu.parallel.mesh); every
+space's [C] rows live wholly on one chip.
+
+Backends:
+  * ``cpu`` -- the XZ-sweep oracle (the reference-equivalent baseline and the
+    parity oracle);
+  * ``tpu`` -- persistent device-resident interest state per bucket, pallas
+    fused kernel, two-stage device event extraction.
+
+Both produce bit-identical events (tests/test_aoi_engine.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ops import aoi_predicate as P
+from ..ops.aoi_oracle import CPUAOIOracle
+from ..ops import events as EV
+
+# A space handle is stable for the space's lifetime; slots inside a bucket are
+# reused after release.
+_MAX_EXTRACT_WORDS = 1 << 14
+
+
+@dataclass
+class SpaceAOIHandle:
+    backend: str
+    capacity: int
+    bucket: "_Bucket"
+    slot: int
+    released: bool = False
+
+
+class AOIEngine:
+    """Per-process registry of AOI state, bucketed by (backend, capacity)."""
+
+    def __init__(self, default_backend: str = "cpu", oracle_algorithm: str = "sweep"):
+        self.default_backend = default_backend
+        self.oracle_algorithm = oracle_algorithm
+        self._buckets: dict[tuple[str, int], _Bucket] = {}
+
+    def create_space(self, capacity: int, backend: str | None = None) -> SpaceAOIHandle:
+        backend = backend or self.default_backend
+        capacity = P.round_capacity(capacity)
+        key = (backend, capacity)
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            if backend == "cpu":
+                bucket = _CPUBucket(capacity, self.oracle_algorithm)
+            elif backend == "tpu":
+                bucket = _TPUBucket(capacity)
+            else:
+                raise ValueError(f"unknown AOI backend {backend!r}")
+            self._buckets[key] = bucket
+        slot = bucket.acquire_slot()
+        return SpaceAOIHandle(backend, capacity, bucket, slot)
+
+    def release_space(self, h: SpaceAOIHandle) -> None:
+        if not h.released:
+            h.bucket.release_slot(h.slot)
+            h.released = True
+
+    def submit(self, h: SpaceAOIHandle, x, z, radius, active) -> None:
+        """Stage one space's tick inputs (numpy arrays of length <= capacity)."""
+        if h.released:
+            raise ValueError("space AOI handle already released")
+        h.bucket.stage(h.slot, (x, z, radius, active))
+
+    def flush(self) -> None:
+        """Execute all staged steps (one batched kernel per bucket); results
+        are then available per space via :meth:`take_events`."""
+        for bucket in self._buckets.values():
+            bucket.flush()
+
+    def take_events(self, h: SpaceAOIHandle):
+        """(enter_pairs, leave_pairs) for this space from the last flush."""
+        return h.bucket.take_events(h.slot)
+
+    def clear_entity(self, h: SpaceAOIHandle, entity_slot: int) -> None:
+        """Erase one entity's row and column from the space's previous-tick
+        interest state.  Called when an entity leaves the space: the runtime
+        severs its interest pairs synchronously (departure events must fire
+        the same tick), so the calculator must not re-emit them as diffs --
+        and a reused slot must start clean."""
+        h.bucket.clear_entity(h.slot, entity_slot)
+
+    def grow_space(self, h: SpaceAOIHandle, new_capacity: int) -> SpaceAOIHandle:
+        """Move a space to a larger-capacity bucket, carrying its interest
+        state so the growth itself emits no enter/leave events.
+
+        The packed layout depends on capacity (planar: bit positions shuffle
+        when W changes), so the carry-over repacks via the boolean matrix.
+        Growth is rare (capacity doubles), so the host-side repack is fine.
+        """
+        new_capacity = P.round_capacity(new_capacity)
+        if new_capacity <= h.capacity:
+            raise ValueError("grow_space requires a larger capacity")
+        old_words = h.bucket.get_prev(h.slot)
+        m = P.unpack_rows(old_words, h.capacity)
+        grown = np.zeros((new_capacity, new_capacity), bool)
+        grown[: h.capacity, : h.capacity] = m
+        nh = self.create_space(new_capacity, h.backend)
+        nh.bucket.set_prev(nh.slot, P.pack_rows(grown))
+        self.release_space(h)
+        return nh
+
+
+class _Bucket:
+    """Slot-managed batch of spaces sharing a backend and capacity."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.W = P.words_per_row(capacity)
+        self.n_slots = 0
+        self._free: list[int] = []
+        self._staged: dict[int, tuple] = {}
+        self._events: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+    def acquire_slot(self) -> int:
+        if self._free:
+            slot = self._free.pop()
+        else:
+            slot = self.n_slots
+            self.n_slots += 1
+            self._grow_to(self.n_slots)
+        self._reset_slot(slot)
+        return slot
+
+    def release_slot(self, slot: int) -> None:
+        self._free.append(slot)
+        self._staged.pop(slot, None)
+        self._events.pop(slot, None)
+
+    def stage(self, slot: int, staged: tuple) -> None:
+        self._staged[slot] = staged
+
+    def take_events(self, slot: int):
+        return self._events.pop(slot, (np.empty((0, 2), np.int32),) * 2)
+
+    # subclass API
+    def _grow_to(self, n_slots: int) -> None:
+        raise NotImplementedError
+
+    def _reset_slot(self, slot: int) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def get_prev(self, slot: int) -> np.ndarray:
+        """Previous-tick interest words [C, W] for state carry-over."""
+        raise NotImplementedError
+
+    def set_prev(self, slot: int, words: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def clear_entity(self, slot: int, entity_slot: int) -> None:
+        raise NotImplementedError
+
+
+class _CPUBucket(_Bucket):
+    def __init__(self, capacity: int, algorithm: str):
+        super().__init__(capacity)
+        self.algorithm = algorithm
+        self._oracles: list[CPUAOIOracle] = []
+
+    def _grow_to(self, n_slots: int) -> None:
+        while len(self._oracles) < n_slots:
+            self._oracles.append(CPUAOIOracle(self.capacity, self.algorithm))
+
+    def _reset_slot(self, slot: int) -> None:
+        self._oracles[slot].reset()
+
+    def flush(self) -> None:
+        for slot, (x, z, r, act) in self._staged.items():
+            self._events[slot] = self._oracles[slot].step(x, z, r, act)
+        self._staged.clear()
+
+    def get_prev(self, slot: int) -> np.ndarray:
+        return self._oracles[slot].prev_words.copy()
+
+    def set_prev(self, slot: int, words: np.ndarray) -> None:
+        self._oracles[slot].prev_words = np.asarray(words, np.uint32).copy()
+
+    def clear_entity(self, slot: int, entity_slot: int) -> None:
+        pw = self._oracles[slot].prev_words
+        pw[entity_slot, :] = 0
+        w, b = P.word_bit_for_column(entity_slot, self.capacity)
+        pw[:, w] &= np.uint32(~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
+
+
+class _TPUBucket(_Bucket):
+    """Device-resident interest state [S, C, W]; one fused kernel per flush.
+
+    S (slot count) grows by doubling; interest state is preserved across
+    growth by zero-padding new slots.  Unstaged slots step with their previous
+    inputs absent -- their rows are marked inactive so they emit leave events
+    only if they had interests and were explicitly reset (slot reuse), never
+    spontaneously: a space that skips a tick simply re-submits nothing and its
+    previous words are carried forward untouched (active=False would wipe
+    them, so unstaged slots are skipped via a host-side mask and their
+    prev rows rewritten unchanged).
+    """
+
+    def __init__(self, capacity: int):
+        super().__init__(capacity)
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+        self.s_max = 0
+        self.prev = None  # [S, C, W] uint32 device array
+        self._pending_reset: set[int] = set()
+        self._pending_clear: list[tuple[int, int]] = []  # (slot, entity_slot)
+
+    def _grow_to(self, n_slots: int) -> None:
+        jnp = self._jnp
+        if n_slots <= self.s_max:
+            return
+        new_s = max(1, self.s_max)
+        while new_s < n_slots:
+            new_s *= 2
+        new_prev = jnp.zeros((new_s, self.capacity, self.W), jnp.uint32)
+        if self.prev is not None and self.s_max > 0:
+            new_prev = new_prev.at[: self.s_max].set(self.prev)
+        self.prev = new_prev
+        self.s_max = new_s
+
+    def _reset_slot(self, slot: int) -> None:
+        self._pending_reset.add(slot)
+
+    def flush(self) -> None:
+        if not self._staged and not self._pending_reset and not self._pending_clear:
+            return
+        import jax
+        import jax.numpy as jnp
+        from ..ops.aoi_pallas import aoi_step_pallas
+
+        c = self.capacity
+        if self._pending_reset:
+            idx = jnp.asarray(sorted(self._pending_reset), jnp.int32)
+            self.prev = self.prev.at[idx].set(jnp.uint32(0))
+            self._pending_reset.clear()
+        if self._pending_clear:
+            for slot, e in self._pending_clear:
+                w, b = P.word_bit_for_column(e, c)
+                mask = jnp.uint32(~(1 << b) & 0xFFFFFFFF)
+                self.prev = self.prev.at[slot, e, :].set(jnp.uint32(0))
+                self.prev = self.prev.at[slot, :, w].set(self.prev[slot, :, w] & mask)
+            self._pending_clear.clear()
+        if not self._staged:
+            return
+
+        slots = sorted(self._staged)
+        s_n = len(slots)
+        x = np.zeros((s_n, c), np.float32)
+        z = np.zeros((s_n, c), np.float32)
+        r = np.zeros((s_n, c), np.float32)
+        act = np.zeros((s_n, c), bool)
+        for row, slot in enumerate(slots):
+            sx, sz, sr, sa = self._staged[slot]
+            n = len(sx)
+            x[row, :n] = sx
+            z[row, :n] = sz
+            r[row, :n] = sr
+            act[row, :n] = sa
+        self._staged.clear()
+
+        slot_idx = jnp.asarray(slots, jnp.int32)
+        prev_rows = self.prev[slot_idx]
+        new, ent, lv = aoi_step_pallas(
+            jnp.asarray(x), jnp.asarray(z), jnp.asarray(r), jnp.asarray(act), prev_rows
+        )
+        self.prev = self.prev.at[slot_idx].set(new)
+
+        ent_rows = self._extract(ent, s_n)
+        lv_rows = self._extract(lv, s_n)
+        empty = np.empty((0, 2), np.int32)
+        for row, slot in enumerate(slots):
+            e = ent_rows.get(row, empty)
+            l = lv_rows.get(row, empty)
+            self._events[slot] = (e, l)
+
+    def clear_entity(self, slot: int, entity_slot: int) -> None:
+        self._pending_clear.append((slot, entity_slot))
+
+    def get_prev(self, slot: int) -> np.ndarray:
+        self.flush()  # apply pending resets/steps before reading
+        return np.asarray(self.prev[slot])
+
+    def set_prev(self, slot: int, words: np.ndarray) -> None:
+        self.flush()
+        self._pending_reset.discard(slot)
+        self.prev = self.prev.at[slot].set(self._jnp.asarray(words, self._jnp.uint32))
+
+    def _extract(self, words, s_n: int) -> dict[int, np.ndarray]:
+        vals, flat_idx, nz = EV.extract_nonzero_words(words, _MAX_EXTRACT_WORDS)
+        if int(nz) > _MAX_EXTRACT_WORDS:
+            # Rare overflow: download the whole bucket's diff and expand host-side.
+            host = np.asarray(words)
+            triples = []
+            for s in range(s_n):
+                p = P.pairs_from_words(host[s], self.capacity)
+                if len(p):
+                    triples.append(
+                        np.concatenate([np.full((len(p), 1), s, np.int32), p], axis=1)
+                    )
+            tri = (
+                np.concatenate(triples)
+                if triples
+                else np.empty((0, 3), np.int32)
+            )
+        else:
+            tri = EV.expand_words_host(vals, flat_idx, self.capacity, s_n)
+        out: dict[int, np.ndarray] = {}
+        if len(tri):
+            for s in np.unique(tri[:, 0]):
+                out[int(s)] = tri[tri[:, 0] == s][:, 1:]
+        return out

@@ -1,0 +1,405 @@
+"""Entity: the unit of game logic.
+
+Re-design of the reference's Entity (/root/reference/engine/entity/Entity.go:44-70):
+identity, attribute tree with client replication classes, RPC, timers, space
+membership, AOI interest sets, client binding, migration data.  Differences
+from the reference are deliberate and TPU/batch-first:
+
+  * AOI events arrive *batched per tick* from the space's calculator (see
+    engine/aoi.py) instead of synchronously during moves;
+  * client-bound traffic (creates/destroys/attr deltas/position sync) is
+    accumulated per tick and flushed by the runtime's sync phase, mirroring
+    the reference's own batched position sync (Entity.go:1221-1267) but
+    applied uniformly;
+  * RPC exposure is declared with decorators (engine/rpc.py), not name
+    suffixes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import TYPE_CHECKING, Any, Iterable
+
+from .attrs import MapAttr
+from .ids import gen_id
+from .vector import Vector3
+
+if TYPE_CHECKING:
+    from .manager import EntityManager, EntityTypeDesc
+    from .space import Space
+
+# sync-info flags (reference: sifSyncOwnClient/sifSyncNeighborClients,
+# Entity.go:1199-1204)
+SYNC_OWN = 1
+SYNC_NEIGHBORS = 2
+
+
+class GameClient:
+    """Server-side handle to a client connection (reference: GameClient.go).
+
+    Wire ops accumulate in ``outbox`` as (op, *payload) tuples; the runtime's
+    sync phase drains them into per-gate packets.  In single-process tests the
+    outbox is inspected directly.
+    """
+
+    __slots__ = ("client_id", "gate_id", "outbox")
+
+    def __init__(self, client_id: str, gate_id: int = 0):
+        self.client_id = client_id
+        self.gate_id = gate_id
+        self.outbox: list[tuple] = []
+
+    # -- ops toward the client (batched) ----------------------------------
+    def create_entity(self, e: "Entity", is_player: bool):
+        self.outbox.append(
+            (
+                "create_entity",
+                e.type_name,
+                e.id,
+                is_player,
+                e.client_visible_attrs(to_owner=is_player),
+                e.position.to_tuple(),
+                e.yaw,
+            )
+        )
+
+    def destroy_entity(self, e: "Entity"):
+        self.outbox.append(("destroy_entity", e.type_name, e.id))
+
+    def attr_delta(self, eid: str, path: tuple, op: str, value: Any):
+        self.outbox.append(("attr_delta", eid, path, op, value))
+
+    def call_client(self, eid: str, method: str, args: tuple):
+        self.outbox.append(("call", eid, method, args))
+
+
+class Entity:
+    """Base class for all game entities.  Subclass and register via
+    ``EntityManager.register``."""
+
+    # -- subclass-overridable declarations --------------------------------
+    # attr replication classes, by top-level attr key
+    client_attrs: frozenset[str] = frozenset()
+    all_client_attrs: frozenset[str] = frozenset()
+    persistent_attrs: frozenset[str] = frozenset()
+    # AOI defaults for this type (reference: SetUseAOI, EntityManager.go:51-59)
+    use_aoi: bool = False
+    aoi_distance: float = 0.0
+    # persistence (reference: EntityTypeDesc.IsPersistent)
+    persistent: bool = False
+
+    def __init__(self):
+        # populated by EntityManager.create; never construct directly
+        self.id: str = ""
+        self.type_name: str = ""
+        self.manager: "EntityManager | None" = None
+        self.desc: "EntityTypeDesc | None" = None
+        self.attrs = MapAttr()
+        self.attrs._owner = self
+        self.position = Vector3()
+        self.yaw: float = 0.0
+        self.space: "Space | None" = None
+        self.aoi_slot: int = -1  # slot in the space's arrays while in a space
+        self.interested_in: set[Entity] = set()
+        self.interested_by: set[Entity] = set()
+        self.client: GameClient | None = None
+        self.client_syncing = False  # accept client-originated position sync
+        self._timer_ids: dict[int, tuple] = {}  # tid -> (method, interval, repeat, args)
+        self._sync_flags = 0
+        self._attr_deltas: list[tuple] = []  # (path, op, value) this tick
+        self.destroyed = False
+
+    # ------------------------------------------------------------------ api
+    @property
+    def is_space(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return f"<{self.type_name}:{self.id}>"
+
+    # -- lifecycle hooks (override in subclasses) -------------------------
+    def on_init(self):  # attrs attached, not yet in any space
+        pass
+
+    def on_created(self):
+        pass
+
+    def on_game_ready(self):  # deployment barrier passed
+        pass
+
+    def on_enter_space(self):
+        pass
+
+    def on_leave_space(self, space: "Space"):
+        pass
+
+    def on_destroy(self):
+        pass
+
+    def on_enter_aoi(self, other: "Entity"):
+        pass
+
+    def on_leave_aoi(self, other: "Entity"):
+        pass
+
+    def on_client_connected(self):
+        pass
+
+    def on_client_disconnected(self):
+        pass
+
+    def on_migrate_out(self):
+        pass
+
+    def on_migrate_in(self):
+        pass
+
+    def on_freeze(self):
+        pass
+
+    def on_restored(self):
+        pass
+
+    # -- attrs ------------------------------------------------------------
+    def _on_attr_delta(self, path: tuple, op: str, value: Any):
+        self._attr_deltas.append((path, op, value))
+
+    def client_visible_attrs(self, to_owner: bool) -> dict:
+        """Snapshot of attrs visible to a client (own client sees ``client``
+        + ``all_clients`` classes; neighbors see ``all_clients`` only)."""
+        keys = set(self.all_client_attrs)
+        if to_owner:
+            keys |= set(self.client_attrs)
+        return {k: v for k, v in self.attrs.to_dict().items() if k in keys}
+
+    def persistent_data(self) -> dict:
+        return {
+            k: v
+            for k, v in self.attrs.to_dict().items()
+            if k in self.persistent_attrs
+        }
+
+    def _flush_attr_deltas(self):
+        """Route this tick's attr deltas to own client / neighbor clients."""
+        if not self._attr_deltas:
+            return
+        deltas = self._attr_deltas
+        self._attr_deltas = []
+        for path, op, value in deltas:
+            top = path[0]
+            to_owner = top in self.client_attrs or top in self.all_client_attrs
+            to_neighbors = top in self.all_client_attrs
+            if to_owner and self.client is not None:
+                self.client.attr_delta(self.id, path, op, value)
+            if to_neighbors:
+                for other in self.interested_by:
+                    if other.client is not None:
+                        other.client.attr_delta(self.id, path, op, value)
+
+    # -- position / AOI ----------------------------------------------------
+    def set_position(self, pos: Vector3):
+        if self.space is not None:
+            self.space.move_entity(self, pos)
+        else:
+            self.position = pos
+        self._sync_flags |= SYNC_NEIGHBORS
+        if not self.client_syncing:
+            # server-driven move must also correct the owner client
+            self._sync_flags |= SYNC_OWN
+
+    def set_yaw(self, yaw: float):
+        self.yaw = float(yaw)
+        self._sync_flags |= SYNC_NEIGHBORS
+        if not self.client_syncing:
+            self._sync_flags |= SYNC_OWN
+
+    def set_client_syncing(self, flag: bool):
+        """Allow the owner client to drive this entity's position
+        (reference: SetClientSyncing, Entity.go:430-440)."""
+        self.client_syncing = bool(flag)
+
+    def sync_position_yaw_from_client(self, pos: Vector3, yaw: float):
+        if not self.client_syncing or self.space is None:
+            return
+        self.space.move_entity(self, pos)
+        self.yaw = float(yaw)
+        self._sync_flags |= SYNC_NEIGHBORS
+
+    # interest bookkeeping -- driven by the space's batched AOI events
+    # (reference: interest/uninterest, Entity.go:236-246)
+    def _interest(self, other: "Entity"):
+        # flush other's pending deltas to its *pre-existing* audience before
+        # we join it: the snapshot below already contains them, and a mirror
+        # that applied both would double-apply non-idempotent ops (APPEND/POP)
+        if self.client is not None:
+            other._flush_attr_deltas()
+        self.interested_in.add(other)
+        other.interested_by.add(self)
+        if self.client is not None:
+            self.client.create_entity(other, is_player=False)
+        self.on_enter_aoi(other)
+
+    def _uninterest(self, other: "Entity"):
+        self.interested_in.discard(other)
+        other.interested_by.discard(self)
+        if self.client is not None:
+            self.client.destroy_entity(other)
+        self.on_leave_aoi(other)
+
+    def neighbors(self) -> Iterable["Entity"]:
+        return self.interested_in
+
+    # -- client binding ----------------------------------------------------
+    def set_client(self, client: GameClient | None):
+        old = self.client
+        if old is not None:
+            old.destroy_entity(self)
+            for other in self.interested_in:
+                old.destroy_entity(other)
+            self.client = None
+            self.on_client_disconnected()
+        if client is not None:
+            # flush pending deltas to the old audiences first -- the
+            # snapshots below already contain them (see _interest)
+            self._flush_attr_deltas()
+            for other in self.interested_in:
+                other._flush_attr_deltas()
+            self.client = client
+            client.create_entity(self, is_player=True)
+            for other in self.interested_in:
+                client.create_entity(other, is_player=False)
+            self.on_client_connected()
+
+    def give_client_to(self, other: "Entity"):
+        """Move client ownership to another local entity (reference:
+        GiveClientTo, Entity.go:752-765; cross-game handoff via migration)."""
+        client = self.client
+        if client is None:
+            return
+        self.set_client(None)
+        other.set_client(client)
+
+    # -- client calls ------------------------------------------------------
+    def call_client(self, method: str, *args):
+        if self.client is not None:
+            self.client.call_client(self.id, method, args)
+
+    def call_all_clients(self, method: str, *args):
+        """Own client + every interested neighbor's client
+        (reference: CallAllClients, Entity.go:743-748)."""
+        self.call_client(method, *args)
+        for other in self.interested_by:
+            if other.client is not None:
+                other.client.call_client(self.id, method, args)
+
+    # -- timers ------------------------------------------------------------
+    def add_callback(self, delay: float, method: str, *args) -> int:
+        """One-shot timer; ``method`` is resolved on this entity so the timer
+        survives migration/freeze by name (reference: Entity.go:271-311)."""
+        tid = self._runtime().timers.add(
+            delay, self._fire_timer, args=(method, args), pass_tid=True
+        )
+        self._timer_ids[tid] = (method, float(delay), False, args)
+        return tid
+
+    def add_timer(self, interval: float, method: str, *args) -> int:
+        tid = self._runtime().timers.add(
+            interval,
+            self._fire_timer,
+            repeat=True,
+            interval=interval,
+            args=(method, args),
+            pass_tid=True,
+        )
+        self._timer_ids[tid] = (method, float(interval), True, args)
+        return tid
+
+    def cancel_timer(self, tid: int):
+        self._timer_ids.pop(tid, None)
+        self._runtime().timers.cancel(tid)
+
+    def _fire_timer(self, tid: int, method: str, args: tuple):
+        if self.destroyed:
+            return
+        rec = self._timer_ids.get(tid)
+        if rec is not None and not rec[2]:
+            # fired one-shots must not leak or re-fire after migration/restore
+            del self._timer_ids[tid]
+        getattr(self, method)(*args)
+
+    def dump_timers(self) -> list:
+        """Serializable timer state for migration/freeze."""
+        return [list(v) for v in self._timer_ids.values()]
+
+    def restore_timers(self, dumped: list):
+        for method, interval, repeat, args in dumped:
+            if repeat:
+                self.add_timer(interval, method, *args)
+            else:
+                self.add_callback(interval, method, *args)
+
+    # -- RPC ---------------------------------------------------------------
+    def call(self, method: str, *args):
+        """In-process direct dispatch (the local fast path; remote routing is
+        the dispatcher fabric's job -- reference EntityManager.go:429-442)."""
+        desc = self.desc.rpc_descs.get(method) if self.desc else None
+        if desc is None:
+            raise AttributeError(f"{self.type_name} has no RPC {method!r}")
+        return desc.func(self, *args)
+
+    def on_call_from_client(self, method: str, args: tuple, client_id: str):
+        from .rpc import may_call
+
+        desc = self.desc.rpc_descs.get(method) if self.desc else None
+        if desc is None:
+            raise AttributeError(f"{self.type_name} has no RPC {method!r}")
+        is_owner = self.client is not None and self.client.client_id == client_id
+        if not may_call(desc, from_client=True, is_owner=is_owner):
+            raise PermissionError(
+                f"client {client_id} may not call {self.type_name}.{method}"
+            )
+        return desc.func(self, *args)
+
+    # -- migration / freeze data ------------------------------------------
+    def migrate_data(self) -> dict:
+        """Full state snapshot for EnterSpace migration and freeze/restore
+        (reference: entityMigrateData, Entity.go:78-89,631-651)."""
+        return {
+            "type": self.type_name,
+            "id": self.id,
+            "attrs": self.attrs.to_dict(),
+            "pos": self.position.to_tuple(),
+            "yaw": self.yaw,
+            "timers": self.dump_timers(),
+            "client": (
+                (self.client.client_id, self.client.gate_id)
+                if self.client
+                else None
+            ),
+            "client_syncing": self.client_syncing,
+            "space_id": self.space.id if self.space else None,
+        }
+
+    # -- destroy -----------------------------------------------------------
+    def destroy(self):
+        if self.destroyed:
+            return
+        self._destroy_impl(is_migrate=False)
+
+    def _destroy_impl(self, is_migrate: bool):
+        self.destroyed = True
+        if self.space is not None:
+            self.space.leave_entity(self)
+        if not is_migrate:
+            self.on_destroy()
+            if self.client is not None:
+                self.client.destroy_entity(self)
+                self.client = None
+        for tid in list(self._timer_ids):
+            self._runtime().timers.cancel(tid)
+        self._timer_ids.clear()
+        if self.manager is not None:
+            self.manager._on_entity_destroyed(self)
+
+    def _runtime(self):
+        return self.manager.runtime

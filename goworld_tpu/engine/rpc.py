@@ -1,0 +1,88 @@
+"""Entity RPC exposure -- declarative, no reflection-by-naming.
+
+The reference encodes who may call a method in its *name suffix* (``Foo``
+server-only, ``Foo_Client`` own client, ``Foo_AllClients`` any client --
+/root/reference/engine/entity/rpc_desc.go:8-46, enforced at
+Entity.go:499-512).  Name-suffix reflection is a Go-ism; here exposure is
+declared with a decorator and collected at registration time into a per-type
+descriptor table:
+
+    class Avatar(Entity):
+        @rpc(expose=OWN_CLIENT)
+        def say(self, text: str): ...
+
+Exposure levels:
+  * SERVER      -- only other server entities may call (the default);
+  * OWN_CLIENT  -- the entity's own client may call (reference ``_Client``);
+  * ALL_CLIENTS -- any client may call (reference ``_AllClients``).
+"""
+
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass
+from typing import Any, Callable
+
+SERVER = "server"
+OWN_CLIENT = "own_client"
+ALL_CLIENTS = "all_clients"
+
+_EXPOSURES = (SERVER, OWN_CLIENT, ALL_CLIENTS)
+_MARK = "_gw_rpc_expose"
+
+
+def rpc(fn: Callable | None = None, *, expose: str = SERVER):
+    """Mark an entity method as remotely callable."""
+    if expose not in _EXPOSURES:
+        raise ValueError(f"unknown exposure {expose!r}")
+
+    def deco(f):
+        setattr(f, _MARK, expose)
+        return f
+
+    return deco(fn) if fn is not None else deco
+
+
+@dataclass(frozen=True)
+class RpcDesc:
+    name: str
+    expose: str
+    func: Callable
+    n_args: int  # positional arity excluding self (for wire validation)
+
+
+def collect_rpc_descs(cls: type) -> dict[str, RpcDesc]:
+    """Walk a class (MRO-aware) and build its RPC descriptor table."""
+    descs: dict[str, RpcDesc] = {}
+    for name in dir(cls):
+        if name.startswith("_"):
+            continue
+        fn = getattr(cls, name, None)
+        expose = getattr(fn, _MARK, None)
+        if expose is None or not callable(fn):
+            continue
+        try:
+            sig = inspect.signature(fn)
+            n_args = len(
+                [
+                    p
+                    for p in sig.parameters.values()
+                    if p.kind
+                    in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                ]
+            ) - 1  # self
+        except (TypeError, ValueError):
+            n_args = -1
+        descs[name] = RpcDesc(name, expose, fn, n_args)
+    return descs
+
+
+def may_call(desc: RpcDesc, *, from_client: bool, is_owner: bool) -> bool:
+    """Access check mirroring the reference's flag test (Entity.go:499-512)."""
+    if not from_client:
+        return True
+    if desc.expose == ALL_CLIENTS:
+        return True
+    if desc.expose == OWN_CLIENT:
+        return is_owner
+    return False

@@ -1,0 +1,107 @@
+"""The game runtime: single logic thread + batched tick phases.
+
+Mirrors the reference's game main loop (GameService.serveRoutine,
+/root/reference/components/game/GameService.go:88-192): one thread runs all
+entity logic; each tick fires timers, executes the batched AOI pass, flushes
+client-bound traffic, and drains the post queue.  Networking components wrap
+this object (components/game); tests drive it directly.
+
+Tick phases (order matters and is part of the engine contract):
+
+  1. timers        -- user logic (AI moves, scheduled callbacks);
+  2. AOI           -- submit dirty spaces, one batched TPU step per bucket,
+                      replay enter/leave events through entity hooks;
+  3. sync          -- collect position/yaw records for every entity flagged
+                      dirty (reference: CollectEntitySyncInfos,
+                      Entity.go:1221-1267) and flush attr deltas;
+  4. post          -- callbacks queued by workers/IO during the tick.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from .aoi import AOIEngine
+from .entity import SYNC_NEIGHBORS, SYNC_OWN, Entity, GameClient
+from .manager import EntityManager
+from .post import PostQueue
+from .timers import TimerQueue
+
+
+class Runtime:
+    def __init__(
+        self,
+        aoi_backend: str = "cpu",
+        now: Callable[[], float] = time.monotonic,
+        on_error: Callable[[BaseException], None] | None = None,
+    ):
+        self.now = now
+        self.on_error = on_error or self._default_on_error
+        self.timers = TimerQueue(now)
+        self.post = PostQueue()
+        self.aoi = AOIEngine(default_backend=aoi_backend)
+        self.entities = EntityManager(self)
+        self.tick_count = 0
+        # position sync records collected this tick:
+        # (client_id, gate_id, entity_id, x, y, z, yaw)
+        self.sync_out: list[tuple] = []
+
+    def _default_on_error(self, e: BaseException):
+        import traceback
+
+        traceback.print_exception(type(e), e, e.__traceback__)
+
+    # -- the tick ----------------------------------------------------------
+    def tick(self):
+        self.tick_count += 1
+        self.timers.tick(self.on_error)
+        self._aoi_phase()
+        self._sync_phase()
+        self.post.tick(self.on_error)
+
+    def _aoi_phase(self):
+        spaces = list(self.entities.spaces.values())
+        staged = [sp for sp in spaces if sp.submit_aoi()]
+        if staged:
+            self.aoi.flush()
+            for sp in staged:
+                sp.dispatch_aoi_events()
+
+    def _sync_phase(self):
+        """Collect position sync + flush attr deltas, batched per tick."""
+        for e in self.entities.entities.values():
+            if e._sync_flags:
+                self._collect_sync(e)
+                e._sync_flags = 0
+            if e._attr_deltas:
+                e._flush_attr_deltas()
+
+    def _collect_sync(self, e: Entity):
+        """One 16-byte-payload record per flagged entity per tick
+        (reference record layout: proto.go:135-139)."""
+        flags = e._sync_flags
+        x, y, z = e.position.to_tuple()
+        if flags & SYNC_OWN and e.client is not None:
+            self.sync_out.append(
+                (e.client.client_id, e.client.gate_id, e.id, x, y, z, e.yaw)
+            )
+        if flags & SYNC_NEIGHBORS:
+            for other in e.interested_by:
+                if other.client is not None:
+                    self.sync_out.append(
+                        (
+                            other.client.client_id,
+                            other.client.gate_id,
+                            e.id,
+                            x,
+                            y,
+                            z,
+                            e.yaw,
+                        )
+                    )
+
+    def drain_sync(self) -> list[tuple]:
+        out = self.sync_out
+        self.sync_out = []
+        return out

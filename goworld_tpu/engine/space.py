@@ -1,0 +1,210 @@
+"""Space: a shard of the world holding co-located entities.
+
+A Space is itself an entity (reference: Space.go:14 ``__space__``); it owns
+the per-space AOI arrays and its handle into the process AOIEngine.  All
+entities in a space are co-located on one game process (and their AOI rows on
+one chip) -- this is the framework's unit of sharding.
+
+Batched AOI protocol per tick (north-star hot loop; reference equivalent:
+Space.go:188-261 enter/leave/move -> go-aoi callbacks):
+
+    * ``enter_entity``/``leave_entity``/``move_entity`` update the packed
+      per-slot arrays (x, z, radius, active) incrementally -- O(1) each;
+    * the runtime's tick calls ``submit_aoi`` then ``AOIEngine.flush`` then
+      ``dispatch_aoi_events``, which replays enter/leave pairs (sorted,
+      deterministic) through Entity._interest/_uninterest.
+
+The nil space (reference: Space.go:127-140) is a kindless space with AOI
+disabled where entities live when not in a real space.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .entity import Entity
+from .vector import Vector3
+
+SPACE_TYPE_NAME = "__space__"
+_MIN_CAPACITY = 128
+
+
+class Space(Entity):
+    # spaces are never AOI members themselves
+    use_aoi = False
+
+    def __init__(self):
+        super().__init__()
+        self.kind = 0
+        self.entities: set[Entity] = set()
+        self._aoi_handle = None
+        self._aoi_default_dist = 0.0
+        # packed per-slot arrays (capacity-sized, grown by doubling)
+        self._cap = 0
+        self._x = np.empty(0, np.float32)
+        self._z = np.empty(0, np.float32)
+        self._r = np.empty(0, np.float32)
+        self._act = np.empty(0, bool)
+        self._slot_entity: list[Entity | None] = []
+        self._free_slots: list[int] = []
+        self._slot_watermark = 0
+        self._aoi_dirty = False
+
+    @property
+    def is_space(self) -> bool:
+        return True
+
+    @property
+    def is_nil(self) -> bool:
+        return self.kind == 0
+
+    def on_space_init(self):  # user hook (reference ISpace)
+        pass
+
+    def on_entity_enter_space(self, e: Entity):
+        pass
+
+    def on_entity_leave_space(self, e: Entity):
+        pass
+
+    # -- AOI management ----------------------------------------------------
+    def enable_aoi(self, default_dist: float, backend: str | None = None):
+        """Turn on interest management for this space (reference:
+        EnableAOI, Space.go:91-107).  Must be called before entities enter."""
+        if self._aoi_handle is not None:
+            raise RuntimeError("AOI already enabled")
+        if self.entities:
+            raise RuntimeError("enable AOI before entities enter the space")
+        self._aoi_default_dist = float(default_dist)
+        self._ensure_capacity(_MIN_CAPACITY)
+        self._aoi_handle = self._runtime().aoi.create_space(self._cap, backend)
+
+    @property
+    def aoi_enabled(self) -> bool:
+        return self._aoi_handle is not None
+
+    def _ensure_capacity(self, n: int):
+        if n <= self._cap:
+            return
+        new_cap = max(_MIN_CAPACITY, self._cap or _MIN_CAPACITY)
+        while new_cap < n:
+            new_cap *= 2
+        for name in ("_x", "_z", "_r"):
+            arr = getattr(self, name)
+            grown = np.zeros(new_cap, np.float32)
+            grown[: len(arr)] = arr
+            setattr(self, name, grown)
+        act = np.zeros(new_cap, bool)
+        act[: len(self._act)] = self._act
+        self._act = act
+        self._slot_entity.extend([None] * (new_cap - len(self._slot_entity)))
+        old_cap = self._cap
+        self._cap = new_cap
+        if self._aoi_handle is not None and old_cap:
+            self._aoi_handle = self._runtime().aoi.grow_space(
+                self._aoi_handle, new_cap
+            )
+
+    # -- membership --------------------------------------------------------
+    def enter_entity(self, e: Entity, pos: Vector3):
+        """Reference: Space.enter, Space.go:188-226."""
+        if e.space is not None:
+            raise ValueError(f"{e} already in a space")
+        e.space = self
+        e.position = pos
+        self.entities.add(e)
+        if self._aoi_handle is not None and e.use_aoi:
+            if self._free_slots:
+                slot = self._free_slots.pop()
+            else:
+                slot = self._next_slot()
+            e.aoi_slot = slot
+            self._slot_entity[slot] = e
+            self._x[slot] = np.float32(pos.x)
+            self._z[slot] = np.float32(pos.z)
+            self._r[slot] = np.float32(
+                e.aoi_distance if e.aoi_distance > 0 else self._aoi_default_dist
+            )
+            self._act[slot] = True
+            self._aoi_dirty = True
+        self.on_entity_enter_space(e)
+        e.on_enter_space()
+
+    def _next_slot(self) -> int:
+        if self._slot_watermark >= self._cap:
+            self._ensure_capacity(self._cap + 1)
+        slot = self._slot_watermark
+        self._slot_watermark += 1
+        return slot
+
+    def leave_entity(self, e: Entity):
+        """Reference: Space.leave, Space.go:228-251."""
+        if e.space is not self:
+            return
+        if e.aoi_slot >= 0:
+            slot = e.aoi_slot
+            self._act[slot] = False
+            self._slot_entity[slot] = None
+            self._free_slots.append(slot)
+            e.aoi_slot = -1
+            self._aoi_dirty = True
+            # erase the slot from the calculator's previous-tick state: the
+            # interests are severed synchronously below, so the batched diff
+            # must not re-emit them (and a reused slot must start clean)
+            self._runtime().aoi.clear_entity(self._aoi_handle, slot)
+            # departure events must fire this tick; sever interests now so
+            # callbacks and client destroys are immediate and deterministic
+            for other in list(e.interested_in):
+                e._uninterest(other)
+            for other in list(e.interested_by):
+                other._uninterest(e)
+        self.entities.discard(e)
+        e.space = None
+        self.on_entity_leave_space(e)
+        e.on_leave_space(self)
+
+    def move_entity(self, e: Entity, pos: Vector3):
+        """Reference: Space.move, Space.go:253-261."""
+        e.position = pos
+        if e.aoi_slot >= 0:
+            self._x[e.aoi_slot] = np.float32(pos.x)
+            self._z[e.aoi_slot] = np.float32(pos.z)
+            self._aoi_dirty = True
+
+    # -- per-tick AOI ------------------------------------------------------
+    def submit_aoi(self) -> bool:
+        """Stage this tick's arrays if anything changed; returns staged?"""
+        if self._aoi_handle is None or not self._aoi_dirty:
+            return False
+        self._runtime().aoi.submit(
+            self._aoi_handle, self._x, self._z, self._r, self._act
+        )
+        self._aoi_dirty = False
+        return True
+
+    def dispatch_aoi_events(self):
+        """Replay batched enter/leave pairs through entity interest hooks."""
+        if self._aoi_handle is None:
+            return
+        enter, leave = self._runtime().aoi.take_events(self._aoi_handle)
+        # leaves first: a slot reused within one tick (leave+enter) must
+        # destroy before re-creating on clients
+        for i, j in leave:
+            a = self._slot_entity[i]
+            b = self._slot_entity[j]
+            if a is not None and b is not None:
+                a._uninterest(b)
+        for i, j in enter:
+            a = self._slot_entity[i]
+            b = self._slot_entity[j]
+            if a is not None and b is not None:
+                a._interest(b)
+
+    # -- destroy -----------------------------------------------------------
+    def _destroy_impl(self, is_migrate: bool):
+        for e in list(self.entities):
+            e.destroy()
+        if self._aoi_handle is not None:
+            self._runtime().aoi.release_space(self._aoi_handle)
+            self._aoi_handle = None
+        super()._destroy_impl(is_migrate)

@@ -1,0 +1,50 @@
+"""float32 3-vector used for entity positions (reference:
+/root/reference/engine/entity/Vector3.go).  AOI operates on the X-Z plane."""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+_f32 = np.float32
+
+
+@dataclass(frozen=True)
+class Vector3:
+    x: float = 0.0
+    y: float = 0.0
+    z: float = 0.0
+
+    def __post_init__(self):
+        object.__setattr__(self, "x", float(_f32(self.x)))
+        object.__setattr__(self, "y", float(_f32(self.y)))
+        object.__setattr__(self, "z", float(_f32(self.z)))
+
+    def distance_to(self, o: "Vector3") -> float:
+        return math.sqrt(
+            (self.x - o.x) ** 2 + (self.y - o.y) ** 2 + (self.z - o.z) ** 2
+        )
+
+    def add(self, o: "Vector3") -> "Vector3":
+        return Vector3(self.x + o.x, self.y + o.y, self.z + o.z)
+
+    def sub(self, o: "Vector3") -> "Vector3":
+        return Vector3(self.x - o.x, self.y - o.y, self.z - o.z)
+
+    def scale(self, s: float) -> "Vector3":
+        return Vector3(self.x * s, self.y * s, self.z * s)
+
+    def normalized(self) -> "Vector3":
+        d = math.sqrt(self.x**2 + self.y**2 + self.z**2)
+        if d == 0:
+            return Vector3()
+        return self.scale(1.0 / d)
+
+    def dir_to_yaw(self) -> float:
+        """Yaw (degrees) of this direction on the X-Z plane."""
+        return math.degrees(math.atan2(self.x, self.z))
+
+    def to_tuple(self):
+        return (self.x, self.y, self.z)

@@ -1,0 +1,96 @@
+"""Pallas TPU kernel for the fused AOI visibility pass.
+
+Fuses predicate evaluation -> bit packing -> XOR diff for a batch of spaces,
+never materializing the [C, C] boolean interest matrix in HBM: each grid step
+produces packed uint32 words directly in VMEM.  This is the hot op of the
+framework (reference hot path: /root/reference/engine/entity/Space.go:253-261
+``aoiMgr.Moved`` + Entity.go:1221-1267 sync collection, batched per tick).
+
+Layout (see aoi_predicate): planar packed words [C, W], W = C/32, where bit k
+of word [i, w] is the interest of entity i in entity j = k*W + w.  Bit-plane k
+is therefore the *contiguous* column slice [k*W, (k+1)*W) -- the kernel packs
+by looping k over 32 contiguous lane-aligned slices (no strided access).
+
+Active handling is folded into the inputs by the wrapper so the kernel has no
+mask operand:
+  * inactive observer  -> radius = -1   (nothing satisfies |d| <= -1)
+  * inactive observed  -> position = +inf (|inf - x| = inf/nan, never <= r)
+Both transformations are exact w.r.t. the predicate -- parity with the CPU
+oracle is preserved bit-for-bit (verified in tests/test_aoi_pallas.py).
+
+Grid: (S, C // TI) -- spaces x row blocks, both parallel.  Per step the kernel
+reads a [TI] row slice of x/z/r, the full [C] column arrays, and the [TI, W]
+previous-words block; it writes new/enter/leave [TI, W] blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .aoi_predicate import WORD_BITS, words_per_row
+
+_INF = float("inf")
+
+
+def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_out, *, ti, w):
+    bi = pl.program_id(1)
+    xr = x_row[0].reshape(ti, 1)
+    zr = z_row[0].reshape(ti, 1)
+    rr = r_row[0].reshape(ti, 1)
+    row_ids = bi * ti + jax.lax.broadcasted_iota(jnp.int32, (ti, 1), 0)
+    col_base = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+
+    def plane(k, acc):
+        xc = x_col[0, pl.ds(k * w, w)].reshape(1, w)
+        zc = z_col[0, pl.ds(k * w, w)].reshape(1, w)
+        m = (jnp.abs(xc - xr) <= rr) & (jnp.abs(zc - zr) <= rr)
+        m &= row_ids != k * w + col_base
+        return acc | (m.astype(jnp.uint32) << k.astype(jnp.uint32))
+
+    acc = jax.lax.fori_loop(
+        0, WORD_BITS, plane, jnp.zeros((ti, w), jnp.uint32)
+    )
+    pw = prev[0]
+    new_out[0] = acc
+    ent_out[0] = acc & ~pw
+    lv_out[0] = pw & ~acc
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
+def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128, interpret=None):
+    """Batched AOI tick on TPU.
+
+    Args: x, z, radius [S, C] f32; active [S, C] bool; prev_words [S, C, W]
+    uint32.  Returns (new_words, enter_words, leave_words), each [S, C, W].
+    Bit-exact with :func:`aoi_dense.aoi_step_dense` and the CPU oracle.
+    """
+    s, c = x.shape
+    w = words_per_row(c)
+    ti = min(block_rows, c)
+    assert c % ti == 0, (c, ti)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # Fold activity into coordinates/radius (exact; see module docstring).
+    x_eff = jnp.where(active, x, jnp.float32(_INF))
+    z_eff = jnp.where(active, z, jnp.float32(_INF))
+    r_eff = jnp.where(active, radius, jnp.float32(-1.0))
+
+    row_spec = pl.BlockSpec((1, ti), lambda si, bi: (si, bi))
+    col_spec = pl.BlockSpec((1, c), lambda si, bi: (si, 0))
+    words_spec = pl.BlockSpec((1, ti, w), lambda si, bi: (si, bi, 0))
+    out_shape = jax.ShapeDtypeStruct((s, c, w), jnp.uint32)
+
+    kernel = functools.partial(_aoi_kernel, ti=ti, w=w)
+    return pl.pallas_call(
+        kernel,
+        grid=(s, c // ti),
+        in_specs=[row_spec, row_spec, row_spec, col_spec, col_spec, words_spec],
+        out_specs=(words_spec, words_spec, words_spec),
+        out_shape=(out_shape, out_shape, out_shape),
+        interpret=interpret,
+    )(x_eff, z_eff, r_eff, x_eff, z_eff, prev_words)

@@ -17,6 +17,8 @@ compacted on device into fixed-capacity index lists (static shapes under jit).
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -44,3 +46,56 @@ def extract_pairs(words, capacity: int, max_events: int):
     i, j = jnp.nonzero(m, size=max_events, fill_value=-1)
     # jnp.nonzero on a row-major matrix is already (i, j)-lexicographic.
     return jnp.stack([i, j], axis=1).astype(jnp.int32), count
+
+
+@functools.partial(jax.jit, static_argnames=("max_words",))
+def _nonzero_words_impl(flat, max_words: int):
+    nz_count = jnp.sum((flat != 0).astype(jnp.int32))
+    (wi,) = jnp.nonzero(flat != 0, size=max_words, fill_value=-1)
+    vals = jnp.where(wi >= 0, flat[wi], jnp.uint32(0))
+    return vals, wi.astype(jnp.int32), nz_count
+
+
+def extract_nonzero_words(words, max_words: int):
+    """Scalable two-stage extraction for batched spaces.
+
+    ``words`` is [S, C, W] (a whole capacity bucket).  Device side finds up to
+    ``max_words`` nonzero uint32 words and their flat indices; the host
+    expands the <=32 set bits of each word with numpy (cheap) instead of
+    unpacking the full [S, C, C] boolean tensor on device.  D2H volume is
+    O(max_words), not O(S*C^2).
+
+    Returns (vals [max_words] uint32, flat_idx [max_words] int32,
+    nonzero_word_count) -- if nonzero_word_count > max_words the caller must
+    fall back to downloading ``words`` and extracting host-side.
+    """
+    s, c, w = words.shape
+    return _nonzero_words_impl(words.reshape(-1), max_words)
+
+
+def expand_words_host(vals, flat_idx, capacity: int, n_spaces: int):
+    """Host-side expansion of extracted words into per-space sorted pairs.
+
+    Returns int32 array [K, 3] of (space, observer, observed), sorted
+    lexicographically -- the deterministic callback replay order.
+    """
+    import numpy as np
+
+    w = words_per_row(capacity)
+    vals = np.asarray(vals)
+    flat_idx = np.asarray(flat_idx)
+    keep = flat_idx >= 0
+    vals, flat_idx = vals[keep], flat_idx[keep]
+    if vals.size == 0:
+        return np.empty((0, 3), np.int32)
+    bits = (vals[:, None] >> np.arange(WORD_BITS, dtype=np.uint32)[None, :]) & 1
+    widx, k = np.nonzero(bits)
+    fi = flat_idx[widx]
+    s = fi // (capacity * w)
+    rem = fi % (capacity * w)
+    i = rem // w
+    word = rem % w
+    j = k * w + word  # planar layout: bit k of word -> column k*W + word
+    out = np.stack([s, i, j], axis=1).astype(np.int32)
+    order = np.lexsort((out[:, 2], out[:, 1], out[:, 0]))
+    return out[order]

@@ -1,0 +1,106 @@
+"""AOIEngine seam tests: CPU vs TPU backend parity at the engine level,
+multi-space bucketing, slot reuse, bucket growth."""
+
+import numpy as np
+
+from goworld_tpu.engine.aoi import AOIEngine
+from test_aoi_parity import random_walk_scenario
+
+
+def run_engine(backend, scenarios, capacity):
+    eng = AOIEngine(default_backend=backend)
+    handles = [eng.create_space(capacity) for _ in scenarios]
+    out = []
+    ticks = len(scenarios[0])
+    for t in range(ticks):
+        for h, sc in zip(handles, scenarios):
+            x, z, r, act = sc[t]
+            eng.submit(h, x, z, r, act)
+        eng.flush()
+        out.append([eng.take_events(h) for h in handles])
+    return eng, handles, out
+
+
+def test_cpu_tpu_engine_parity_multi_space():
+    cap = 256
+    scenarios = [
+        list(random_walk_scenario(seed, cap, 200, 4, tie_lattice=(seed % 2 == 0)))
+        for seed in range(3)
+    ]
+    _, _, cpu_out = run_engine("cpu", scenarios, cap)
+    _, _, tpu_out = run_engine("tpu", scenarios, cap)
+    for t, (cpu_tick, tpu_tick) in enumerate(zip(cpu_out, tpu_out)):
+        for s, ((ce, cl), (te, tl)) in enumerate(zip(cpu_tick, tpu_tick)):
+            np.testing.assert_array_equal(ce, te, err_msg=f"enter t={t} space={s}")
+            np.testing.assert_array_equal(cl, tl, err_msg=f"leave t={t} space={s}")
+
+
+def test_slot_reuse_no_ghost_events():
+    cap = 128
+    for backend in ("cpu", "tpu"):
+        eng = AOIEngine(default_backend=backend)
+        h1 = eng.create_space(cap)
+        x = np.zeros(cap, np.float32)
+        r = np.full(cap, 10, np.float32)
+        act = np.zeros(cap, bool)
+        act[:2] = True
+        eng.submit(h1, x, x, r, act)
+        eng.flush()
+        e, l = eng.take_events(h1)
+        assert len(e) == 2, backend
+        eng.release_space(h1)
+        # new space reuses the slot; its first tick must not see stale interest
+        h2 = eng.create_space(cap)
+        assert h2.slot == h1.slot
+        eng.submit(h2, x, x, r, np.zeros(cap, bool))
+        eng.flush()
+        e, l = eng.take_events(h2)
+        assert len(e) == 0 and len(l) == 0, f"{backend}: ghost events {e} {l}"
+
+
+def test_bucket_growth_preserves_state():
+    cap = 128
+    for backend in ("cpu", "tpu"):
+        eng = AOIEngine(default_backend=backend)
+        h1 = eng.create_space(cap)
+        x = np.zeros(cap, np.float32)
+        r = np.full(cap, 10, np.float32)
+        act = np.zeros(cap, bool)
+        act[:2] = True
+        eng.submit(h1, x, x, r, act)
+        eng.flush()
+        assert len(eng.take_events(h1)[0]) == 2
+        # adding more spaces grows the TPU bucket; h1's interest state survives
+        hs = [eng.create_space(cap) for _ in range(3)]
+        for h in hs:
+            eng.submit(h, x, x, r, np.zeros(cap, bool))
+        eng.submit(h1, x, x, r, act)
+        eng.flush()
+        e, l = eng.take_events(h1)
+        assert len(e) == 0 and len(l) == 0, f"{backend}: state lost on growth"
+
+
+def test_unstaged_space_keeps_state():
+    cap = 128
+    for backend in ("cpu", "tpu"):
+        eng = AOIEngine(default_backend=backend)
+        h1 = eng.create_space(cap)
+        h2 = eng.create_space(cap)
+        x = np.zeros(cap, np.float32)
+        r = np.full(cap, 10, np.float32)
+        act = np.zeros(cap, bool)
+        act[:2] = True
+        eng.submit(h1, x, x, r, act)
+        eng.submit(h2, x, x, r, act)
+        eng.flush()
+        eng.take_events(h1), eng.take_events(h2)
+        # tick 2: only h2 steps; h1 keeps its interests and reports no events
+        eng.submit(h2, x, x, r, act)
+        eng.flush()
+        e1, l1 = eng.take_events(h1)
+        assert len(e1) == 0 and len(l1) == 0
+        # tick 3: h1 steps again with same inputs -> no events (state kept)
+        eng.submit(h1, x, x, r, act)
+        eng.flush()
+        e, l = eng.take_events(h1)
+        assert len(e) == 0 and len(l) == 0, f"{backend}: lost state while idle"

@@ -1,0 +1,78 @@
+"""Pallas AOI kernel parity vs the dense JAX backend and the CPU oracle
+(interpret mode on CPU; the same kernel compiles for TPU)."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.ops import (
+    CPUAOIOracle,
+    aoi_step_dense_batched,
+    pairs_from_words,
+    round_capacity,
+    words_per_row,
+)
+from goworld_tpu.ops.aoi_pallas import aoi_step_pallas
+
+from test_aoi_parity import random_walk_scenario
+
+
+@pytest.mark.parametrize("tie_lattice", [False, True])
+def test_pallas_matches_dense_multitick(tie_lattice):
+    import jax.numpy as jnp
+
+    cap = round_capacity(256)
+    w = words_per_row(cap)
+    n_spaces = 3
+    scenarios = [
+        list(random_walk_scenario(seed, cap, 200, 4, tie_lattice))
+        for seed in range(n_spaces)
+    ]
+    prev_d = jnp.zeros((n_spaces, cap, w), jnp.uint32)
+    prev_p = jnp.zeros((n_spaces, cap, w), jnp.uint32)
+    for t in range(4):
+        x = jnp.asarray(np.stack([s[t][0] for s in scenarios]))
+        z = jnp.asarray(np.stack([s[t][1] for s in scenarios]))
+        r = jnp.asarray(np.stack([s[t][2] for s in scenarios]))
+        act = jnp.asarray(np.stack([s[t][3] for s in scenarios]))
+        nd, ed, ld = aoi_step_dense_batched(x, z, r, act, prev_d)
+        np_, ep, lp = aoi_step_pallas(x, z, r, act, prev_p)
+        prev_d, prev_p = nd, np_
+        for arr_d, arr_p, name in [(nd, np_, "new"), (ed, ep, "enter"), (ld, lp, "leave")]:
+            np.testing.assert_array_equal(
+                np.asarray(arr_d), np.asarray(arr_p), err_msg=f"{name} words diverge at tick {t}"
+            )
+
+
+def test_pallas_matches_oracle_events():
+    import jax.numpy as jnp
+
+    cap = round_capacity(300)
+    w = words_per_row(cap)
+    oracle = CPUAOIOracle(cap, "pairwise")
+    prev = jnp.zeros((1, cap, w), jnp.uint32)
+    for x, z, r, act in random_walk_scenario(11, cap, 250, 5, tie_lattice=True):
+        e_ref, l_ref = oracle.step(x, z, r, act)
+        new, ent, lv = aoi_step_pallas(
+            jnp.asarray(x)[None], jnp.asarray(z)[None], jnp.asarray(r)[None],
+            jnp.asarray(act)[None], prev,
+        )
+        prev = new
+        np.testing.assert_array_equal(pairs_from_words(np.asarray(ent[0]), cap), e_ref)
+        np.testing.assert_array_equal(pairs_from_words(np.asarray(lv[0]), cap), l_ref)
+
+
+def test_pallas_block_rows_invariance():
+    import jax.numpy as jnp
+
+    cap = round_capacity(256)
+    w = words_per_row(cap)
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.uniform(0, 100, (2, cap)).astype(np.float32))
+    z = jnp.asarray(rng.uniform(0, 100, (2, cap)).astype(np.float32))
+    r = jnp.asarray(np.full((2, cap), 10, np.float32))
+    act = jnp.asarray(rng.random((2, cap)) < 0.7)
+    prev = jnp.zeros((2, cap, w), jnp.uint32)
+    a = aoi_step_pallas(x, z, r, act, prev, block_rows=128)
+    b = aoi_step_pallas(x, z, r, act, prev, block_rows=64)
+    for u, v in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(u), np.asarray(v))

@@ -1,0 +1,93 @@
+"""Attr-system semantics (mirrors reference test intent:
+engine/entity/attr_test.go -- uniformization, nesting, deltas)."""
+
+import pytest
+
+from goworld_tpu.engine.attrs import APPEND, DEL, POP, SET, ListAttr, MapAttr, apply_delta
+
+
+class Sink:
+    def __init__(self):
+        self.deltas = []
+
+    def _on_attr_delta(self, path, op, value):
+        self.deltas.append((path, op, value))
+
+
+def rooted():
+    root = MapAttr()
+    sink = Sink()
+    root._owner = sink
+    return root, sink
+
+
+def test_uniformization_and_roundtrip():
+    root, _ = rooted()
+    root.set("profile", {"name": "bob", "tags": ["a", "b"], "deep": {"n": 1}})
+    assert isinstance(root["profile"], MapAttr)
+    assert isinstance(root["profile"]["tags"], ListAttr)
+    assert root.to_dict() == {
+        "profile": {"name": "bob", "tags": ["a", "b"], "deep": {"n": 1}}
+    }
+
+
+def test_deltas_record_full_paths():
+    root, sink = rooted()
+    root.set("hp", 100)
+    root.get_map("bag").set("gold", 5)
+    root["bag"].get_list("items").append("sword")
+    root["bag"]["items"].set(0, "axe")
+    root.delete("hp")
+    assert sink.deltas == [
+        (("hp",), SET, 100),
+        (("bag",), SET, {}),            # get_map auto-creates
+        (("bag", "gold"), SET, 5),
+        (("bag", "items"), SET, []),    # get_list auto-creates
+        (("bag", "items", 0), APPEND, "sword"),
+        (("bag", "items", 0), SET, "axe"),
+        (("hp",), DEL, None),
+    ]
+
+
+def test_apply_delta_mirrors():
+    root, sink = rooted()
+    mirror = MapAttr()
+    root.set("a", {"b": [1, 2]})
+    root["a"]["b"].append(3)
+    root["a"].set("c", "x")
+    root["a"]["b"].pop(0)
+    for path, op, value in sink.deltas:
+        apply_delta(mirror, path, op, value)
+    assert mirror.to_dict() == root.to_dict()
+
+
+def test_node_cannot_live_in_two_trees():
+    root, _ = rooted()
+    shared = MapAttr({"k": 1})
+    root.set("one", shared)
+    with pytest.raises(ValueError):
+        root.set("two", shared)
+
+
+def test_typed_getters():
+    root, _ = rooted()
+    root.set("n", 3)
+    root.set("s", "hi")
+    assert root.get_int("n") == 3
+    assert root.get_str("s") == "hi"
+    assert root.get_float("missing", 1.5) == 1.5
+    with pytest.raises(TypeError):
+        root.get_map("n")
+
+
+def test_negative_pop_delta_replays_correctly():
+    root, sink = rooted()
+    root.set("l", ["a", "b", "c"])
+    mirror = MapAttr()
+    for path, op, value in sink.deltas:
+        apply_delta(mirror, path, op, value)
+    sink.deltas.clear()
+    root["l"].pop(-2)
+    for path, op, value in sink.deltas:
+        apply_delta(mirror, path, op, value)
+    assert mirror.to_dict() == root.to_dict() == {"l": ["a", "c"]}

@@ -1,0 +1,237 @@
+"""End-to-end runtime tests: spaces, AOI-driven interest, client replication,
+timers, RPC -- with CPU and TPU AOI backends producing identical behavior.
+(Reference scenario model: examples/unity_demo -- players+monsters with AOI.)"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.engine.entity import Entity, GameClient
+from goworld_tpu.engine.rpc import ALL_CLIENTS, OWN_CLIENT, rpc
+from goworld_tpu.engine.runtime import Runtime
+from goworld_tpu.engine.space import Space
+from goworld_tpu.engine.vector import Vector3
+
+
+class MyScene(Space):
+    pass
+
+
+class Player(Entity):
+    use_aoi = True
+    aoi_distance = 100.0
+    client_attrs = frozenset({"secrets"})
+    all_client_attrs = frozenset({"name", "hp"})
+    persistent_attrs = frozenset({"name", "hp", "secrets"})
+    persistent = True
+
+    def __init__(self):
+        super().__init__()
+        self.seen = []
+        self.lost = []
+
+    def on_enter_aoi(self, other):
+        self.seen.append(other.id)
+
+    def on_leave_aoi(self, other):
+        self.lost.append(other.id)
+
+    @rpc(expose=OWN_CLIENT)
+    def say(self, text):
+        return f"{self.attrs.get_str('name')}: {text}"
+
+    @rpc(expose=ALL_CLIENTS)
+    def wave(self):
+        return "wave"
+
+    @rpc
+    def admin_kick(self):
+        return "kicked"
+
+
+def build(backend):
+    rt = Runtime(aoi_backend=backend)
+    rt.entities.register(MyScene)
+    rt.entities.register(Player)
+    scene = rt.entities.create_space("MyScene", kind=1)
+    scene.enable_aoi(100.0)
+    return rt, scene
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_aoi_interest_lifecycle(backend):
+    rt, scene = build(backend)
+    a = rt.entities.create("Player", space=scene, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Player", space=scene, pos=Vector3(50, 0, 50))
+    c = rt.entities.create("Player", space=scene, pos=Vector3(500, 0, 500))
+    rt.tick()
+    assert a.seen == [b.id] and b.seen == [a.id] and c.seen == []
+    assert b in a.interested_in and a in b.interested_by
+
+    # c walks into range of both
+    c.set_position(Vector3(60, 0, 60))
+    rt.tick()
+    assert set(a.seen) == {b.id, c.id}
+    assert set(c.seen) == {a.id, b.id}
+
+    # b walks away
+    b.set_position(Vector3(400, 0, 400))
+    rt.tick()
+    assert a.lost == [b.id] and b.lost == [a.id, c.id]
+
+    # destroy c: interests sever immediately
+    c.destroy()
+    assert c.id in a.lost
+    assert all(c not in e.interested_in for e in (a, b))
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_leave_space_and_slot_reuse_clean(backend):
+    rt, scene = build(backend)
+    a = rt.entities.create("Player", space=scene, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Player", space=scene, pos=Vector3(10, 0, 10))
+    rt.tick()
+    assert a.interested_in == {b}
+    slot_b = b.aoi_slot
+    scene.leave_entity(b)
+    assert a.interested_in == set() and a.lost == [b.id]
+    # new entity reuses b's slot; must not inherit b's interest state
+    d = rt.entities.create("Player", space=scene, pos=Vector3(1000, 0, 1000))
+    assert d.aoi_slot == slot_b
+    rt.tick()
+    assert d.seen == [] and a.seen == [b.id]  # no ghost enter/leave
+    rt.tick()
+    assert d.seen == [] and d.lost == []
+
+
+def test_client_replication_and_sync():
+    rt, scene = build("cpu")
+    a = rt.entities.create("Player", space=scene, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Player", space=scene, pos=Vector3(10, 0, 10))
+    cli = GameClient("client_a")
+    a.set_client(cli)
+    assert cli.outbox[0][:3] == ("create_entity", "Player", a.id)
+    cli.outbox.clear()
+    rt.tick()
+    # b entered a's AOI -> created on a's client
+    assert ("create_entity", "Player", b.id) == tuple(cli.outbox[0][:3])
+    cli.outbox.clear()
+
+    # all_clients attr on b replicates to a's client; client attr does not
+    b.attrs.set("hp", 99)
+    b.attrs.set("secrets", "hidden")
+    rt.tick()
+    deltas = [op for op in cli.outbox if op[0] == "attr_delta"]
+    assert deltas == [("attr_delta", b.id, ("hp",), "set", 99)]
+
+    # b moves -> position sync record for a's client
+    b.set_position(Vector3(12, 0, 12))
+    rt.tick()
+    sync = rt.drain_sync()
+    assert ("client_a", 0, b.id, 12.0, 0.0, 12.0, 0.0) in sync
+
+    # visible attr snapshot rules
+    vis_owner = b.client_visible_attrs(to_owner=True)
+    vis_other = b.client_visible_attrs(to_owner=False)
+    assert "secrets" in vis_owner and "secrets" not in vis_other
+
+
+def test_rpc_exposure_enforcement():
+    rt, scene = build("cpu")
+    a = rt.entities.create("Player", space=scene, pos=Vector3(0, 0, 0))
+    a.attrs.set("name", "alice")
+    a.set_client(GameClient("cli1"))
+    assert a.on_call_from_client("say", ("hi",), "cli1") == "alice: hi"
+    with pytest.raises(PermissionError):
+        a.on_call_from_client("say", ("hi",), "cli2")  # not owner
+    assert a.on_call_from_client("wave", (), "cli2") == "wave"  # all-clients
+    with pytest.raises(PermissionError):
+        a.on_call_from_client("admin_kick", (), "cli1")  # server-only
+    assert a.call("admin_kick") == "kicked"  # server side ok
+
+
+def test_timers_fire_and_survive_dump_restore():
+    t = [0.0]
+    rt = Runtime(aoi_backend="cpu", now=lambda: t[0])
+    rt.entities.register(MyScene)
+    rt.entities.register(Player)
+    scene = rt.entities.create_space("MyScene")
+    scene.enable_aoi(10)
+    p = rt.entities.create("Player", space=scene, pos=Vector3())
+    calls = []
+    p.greet = lambda who: calls.append(who)  # bound late for test
+    p.add_callback(1.0, "greet", "once")
+    p.add_timer(2.0, "greet", "rep")
+    t[0] = 1.5
+    rt.tick()
+    assert calls == ["once"]
+    t[0] = 4.5
+    rt.tick()
+    assert calls.count("rep") >= 1
+    dumped = p.dump_timers()
+    assert ["greet", 2.0, True, ("rep",)] in [list(d) for d in dumped]
+
+
+def test_migrate_data_roundtrip():
+    rt, scene = build("cpu")
+    a = rt.entities.create("Player", space=scene, pos=Vector3(5, 1, 5))
+    a.attrs.set("name", "mig")
+    a.add_timer(3.0, "say", "x")
+    data = a.migrate_data()
+    a._destroy_impl(is_migrate=True)
+    assert rt.entities.get(a.id) is None
+
+    b = rt.entities.restore(data)
+    assert b.id == a.id and b.attrs.get_str("name") == "mig"
+    assert b.position.to_tuple() == (5.0, 1.0, 5.0)
+    assert b.dump_timers() == [["say", 3.0, True, ("x",)]]
+
+
+def test_space_capacity_growth_preserves_interest():
+    rt, scene = build("cpu")
+    ents = [
+        rt.entities.create("Player", space=scene, pos=Vector3(i, 0, 0))
+        for i in range(2)
+    ]
+    rt.tick()
+    assert ents[0].interested_in == {ents[1]}
+    # push past the 128-slot minimum to force growth
+    more = [
+        rt.entities.create("Player", space=scene, pos=Vector3(5000 + i, 0, 0))
+        for i in range(130)
+    ]
+    rt.tick()
+    # original pair unaffected by growth: no duplicate enter, no leave
+    assert ents[0].seen.count(ents[1].id) == 1
+    assert ents[0].lost == []
+    assert scene._cap >= 132
+
+
+def test_snapshot_then_delta_no_double_apply():
+    """A client that receives a snapshot mid-tick must not also receive the
+    deltas that snapshot already contains (APPEND would double-apply)."""
+    rt, scene = build("cpu")
+    a = rt.entities.create("Player", space=scene, pos=Vector3(0, 0, 0))
+    a.attrs.get_list("hp_log")  # ensure list exists pre-snapshot... 
+    a.attrs.set("name", "x")
+    cli = GameClient("c1")
+    a.set_client(cli)  # snapshot includes name
+    rt.tick()
+    deltas = [op for op in cli.outbox if op[0] == "attr_delta" and op[2][0] == "name"]
+    assert deltas == [], f"stale pre-snapshot deltas leaked: {deltas}"
+
+
+def test_one_shot_timer_does_not_leak_or_refire():
+    t = [0.0]
+    rt = Runtime(aoi_backend="cpu", now=lambda: t[0])
+    rt.entities.register(MyScene)
+    rt.entities.register(Player)
+    scene = rt.entities.create_space("MyScene")
+    scene.enable_aoi(10)
+    p = rt.entities.create("Player", space=scene, pos=Vector3())
+    calls = []
+    p.greet = lambda who: calls.append(who)
+    p.add_callback(1.0, "greet", "boom")
+    t[0] = 2.0
+    rt.tick()
+    assert calls == ["boom"]
+    assert p.dump_timers() == []  # fired one-shot must not survive to migration
