@@ -28,7 +28,7 @@ Both produce bit-identical events (tests/test_aoi_engine.py).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -120,6 +120,12 @@ class AOIEngine:
         grown[: h.capacity, : h.capacity] = m
         nh = self.create_space(new_capacity, h.backend)
         nh.bucket.set_prev(nh.slot, P.pack_rows(grown))
+        # carry undelivered events: growth can happen between flush() and
+        # dispatch_aoi_events() (e.g. an on_enter_aoi hook spawns entities);
+        # dropping them would permanently desync interest sets
+        pending = h.bucket._events.pop(h.slot, None)
+        if pending is not None:
+            nh.bucket._events[nh.slot] = pending
         self.release_space(h)
         return nh
 
@@ -250,8 +256,8 @@ class _TPUBucket(_Bucket):
     def flush(self) -> None:
         if not self._staged and not self._pending_reset and not self._pending_clear:
             return
-        import jax
         import jax.numpy as jnp
+
         from ..ops.aoi_pallas import aoi_step_pallas
 
         c = self.capacity

@@ -17,11 +17,9 @@ from the reference are deliberate and TPU/batch-first:
 
 from __future__ import annotations
 
-import time
 from typing import TYPE_CHECKING, Any, Iterable
 
 from .attrs import MapAttr
-from .ids import gen_id
 from .vector import Vector3
 
 if TYPE_CHECKING:
@@ -328,15 +326,39 @@ class Entity:
         getattr(self, method)(*args)
 
     def dump_timers(self) -> list:
-        """Serializable timer state for migration/freeze."""
-        return [list(v) for v in self._timer_ids.values()]
+        """Serializable timer state for migration/freeze.  Records the time
+        *remaining* until next fire so the timer keeps its phase on the
+        destination (reference behavior: restore by FireTime - now,
+        Entity.go:349-390).  Record: [method, interval, repeat, args, remaining]."""
+        timers = self._runtime().timers
+        out = []
+        for tid, (method, interval, repeat, args) in self._timer_ids.items():
+            remaining = timers.remaining(tid)
+            if remaining is None:
+                continue
+            out.append([method, interval, repeat, args, remaining])
+        return out
 
     def restore_timers(self, dumped: list):
-        for method, interval, repeat, args in dumped:
+        for method, interval, repeat, args, remaining in dumped:
             if repeat:
-                self.add_timer(interval, method, *args)
+                tid = self._runtime().timers.add(
+                    remaining,
+                    self._fire_timer,
+                    repeat=True,
+                    interval=interval,
+                    args=(method, tuple(args)),
+                    pass_tid=True,
+                )
+                self._timer_ids[tid] = (method, float(interval), True, tuple(args))
             else:
-                self.add_callback(interval, method, *args)
+                tid = self._runtime().timers.add(
+                    remaining,
+                    self._fire_timer,
+                    args=(method, tuple(args)),
+                    pass_tid=True,
+                )
+                self._timer_ids[tid] = (method, float(interval), False, tuple(args))
 
     # -- RPC ---------------------------------------------------------------
     def call(self, method: str, *args):
@@ -357,6 +379,13 @@ class Entity:
         if not may_call(desc, from_client=True, is_owner=is_owner):
             raise PermissionError(
                 f"client {client_id} may not call {self.type_name}.{method}"
+            )
+        if not desc.arity_ok(len(args)):
+            # reject malformed client input at the wire boundary, not inside
+            # entity logic
+            raise TypeError(
+                f"{self.type_name}.{method} expects "
+                f"{desc.min_args}..{desc.max_args} args, got {len(args)}"
             )
         return desc.func(self, *args)
 

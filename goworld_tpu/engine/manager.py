@@ -9,11 +9,10 @@ Entity subclass; RPC exposure comes from decorators (engine/rpc.py).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Any
+from dataclasses import dataclass
+from typing import TYPE_CHECKING
 
-from .attrs import MapAttr
-from .entity import Entity, GameClient
+from .entity import Entity
 from .ids import gen_id
 from .rpc import RpcDesc, collect_rpc_descs
 from .vector import Vector3

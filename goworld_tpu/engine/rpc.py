@@ -48,7 +48,13 @@ class RpcDesc:
     name: str
     expose: str
     func: Callable
-    n_args: int  # positional arity excluding self (for wire validation)
+    min_args: int  # required positional arity excluding self
+    max_args: int | None  # None = *args (unbounded)
+
+    def arity_ok(self, n: int) -> bool:
+        if n < self.min_args:
+            return False
+        return self.max_args is None or n <= self.max_args
 
 
 def collect_rpc_descs(cls: type) -> dict[str, RpcDesc]:
@@ -61,19 +67,19 @@ def collect_rpc_descs(cls: type) -> dict[str, RpcDesc]:
         expose = getattr(fn, _MARK, None)
         if expose is None or not callable(fn):
             continue
+        min_args, max_args = 0, 0
         try:
-            sig = inspect.signature(fn)
-            n_args = len(
-                [
-                    p
-                    for p in sig.parameters.values()
-                    if p.kind
-                    in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
-                ]
-            ) - 1  # self
+            for p in list(inspect.signature(fn).parameters.values())[1:]:  # skip self
+                if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+                    if max_args is not None:
+                        max_args += 1
+                    if p.default is p.empty:
+                        min_args += 1
+                elif p.kind == p.VAR_POSITIONAL:
+                    max_args = None
         except (TypeError, ValueError):
-            n_args = -1
-        descs[name] = RpcDesc(name, expose, fn, n_args)
+            min_args, max_args = 0, None
+        descs[name] = RpcDesc(name, expose, fn, min_args, max_args)
     return descs
 
 

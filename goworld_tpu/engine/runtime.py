@@ -23,7 +23,7 @@ import time
 from typing import Callable
 
 from .aoi import AOIEngine
-from .entity import SYNC_NEIGHBORS, SYNC_OWN, Entity, GameClient
+from .entity import SYNC_NEIGHBORS, SYNC_OWN, Entity
 from .manager import EntityManager
 from .post import PostQueue
 from .timers import TimerQueue

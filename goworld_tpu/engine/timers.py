@@ -34,9 +34,20 @@ class TimerQueue:
             raise ValueError("repeating timer needs a positive interval")
         tid = next(self._seq)
         fire = self._now() + max(0.0, delay)
-        self._entries[tid] = _Timer(fn, bool(repeat), interval or 0.0, args, pass_tid)
+        t = _Timer(fn, bool(repeat), interval or 0.0, args, pass_tid)
+        t.fire_at = fire
+        self._entries[tid] = t
         heapq.heappush(self._heap, (fire, tid))
         return tid
+
+    def remaining(self, tid: int) -> float | None:
+        """Seconds until the timer next fires (None if unknown tid).  Used to
+        preserve timer phase across migration/freeze (the dump records time
+        remaining, not the original delay)."""
+        t = self._entries.get(tid)
+        if t is None:
+            return None
+        return max(0.0, t.fire_at - self._now())
 
     def cancel(self, tid: int) -> bool:
         return self._entries.pop(tid, None) is not None
@@ -51,7 +62,8 @@ class TimerQueue:
             if t is None:  # cancelled
                 continue
             if t.repeat:
-                heapq.heappush(self._heap, (now + t.interval, tid))
+                t.fire_at = now + t.interval
+                heapq.heappush(self._heap, (t.fire_at, tid))
             else:
                 del self._entries[tid]
             try:
@@ -80,7 +92,7 @@ class TimerQueue:
 
 
 class _Timer:
-    __slots__ = ("fn", "repeat", "interval", "args", "pass_tid")
+    __slots__ = ("fn", "repeat", "interval", "args", "pass_tid", "fire_at")
 
     def __init__(self, fn, repeat, interval, args, pass_tid=False):
         self.fn = fn
@@ -88,3 +100,4 @@ class _Timer:
         self.interval = interval
         self.args = args
         self.pass_tid = pass_tid
+        self.fire_at = 0.0

@@ -1,0 +1,78 @@
+"""Space sharding across TPU chips.
+
+The framework's unit of parallelism is the Space (reference analog: spaces
+shard across game processes and never move -- /root/reference/cn docs, SURVEY
+§2.4).  On TPU, the AOI arrays of S spaces form a leading batch dimension and
+shard over a 1-D device mesh ('space' axis): every space's [C] rows live
+wholly on one chip, so the per-tick AOI kernel needs **zero cross-chip
+collectives** -- the only collective in the step is an optional psum of event
+counts for cluster monitoring (riding ICI, negligible).
+
+This mirrors the reference's key scaling property (all entities of a space
+co-located; intra-space work never crosses process boundaries) in XLA terms:
+shard_map partitions the batched step; each chip runs its own Pallas grid.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
+
+from ..ops.aoi_pallas import aoi_step_pallas
+from ..ops.aoi_dense import aoi_step_dense_batched
+
+
+class SpaceMesh:
+    """A 1-D mesh over which space batches shard."""
+
+    def __init__(self, devices=None, axis: str = "space"):
+        devices = devices if devices is not None else jax.devices()
+        self.axis = axis
+        self.mesh = Mesh(list(devices), (axis,))
+        self.n_devices = len(devices)
+
+    def sharding(self) -> NamedSharding:
+        """NamedSharding that splits the leading (space) axis."""
+        return NamedSharding(self.mesh, PS(self.axis))
+
+    def device_put(self, arr):
+        return jax.device_put(arr, self.sharding())
+
+
+def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
+                          block_rows: int = 128):
+    """Build the multi-chip AOI tick: [S, C] arrays sharded over chips.
+
+    S must be a multiple of the mesh size.  Returns a jitted function
+    ``step(x, z, r, active, prev) -> (new, enter, leave, total_events)``
+    where total_events is a scalar psum over the mesh (the only collective).
+    """
+    mesh = space_mesh.mesh
+    axis = space_mesh.axis
+
+    def _local(x, z, r, act, prev):
+        if use_pallas:
+            new, ent, lv = aoi_step_pallas(x, z, r, act, prev,
+                                           block_rows=block_rows)
+        else:
+            new, ent, lv = aoi_step_dense_batched(x, z, r, act, prev)
+        local_events = jnp.sum(
+            jax.lax.population_count(ent) + jax.lax.population_count(lv),
+            dtype=jnp.int32,
+        )
+        total = jax.lax.psum(local_events, axis)
+        return new, ent, lv, total
+
+    spec = PS(axis)
+    step = jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=(spec, spec, spec, PS()),
+        # pallas_call out_shapes carry no vma annotations; skip the check
+        check_vma=False,
+    )
+    return jax.jit(step)

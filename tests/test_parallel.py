@@ -1,0 +1,50 @@
+"""Multi-chip space sharding on the virtual 8-device CPU mesh: the sharded
+step must (a) run with the spaces axis actually partitioned, (b) produce
+bit-identical results to the single-device path, (c) psum event counts."""
+
+import numpy as np
+import pytest
+
+
+def test_sharded_step_matches_single_device():
+    import jax
+    import jax.numpy as jnp
+
+    from goworld_tpu.ops import aoi_step_dense_batched, round_capacity, words_per_row
+    from goworld_tpu.parallel import SpaceMesh, make_sharded_aoi_step
+
+    assert len(jax.devices()) == 8, "conftest must force 8 cpu devices"
+    cap = round_capacity(128)
+    w = words_per_row(cap)
+    S = 16  # 2 spaces per device
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 300, (S, cap)).astype(np.float32)
+    z = rng.uniform(0, 300, (S, cap)).astype(np.float32)
+    r = np.full((S, cap), 30, np.float32)
+    act = rng.random((S, cap)) < 0.8
+    prev = np.zeros((S, cap, w), np.uint32)
+
+    sm = SpaceMesh()
+    step = make_sharded_aoi_step(sm, use_pallas=True)
+    xs, zs, rs = sm.device_put(x), sm.device_put(z), sm.device_put(r)
+    acts, prevs = sm.device_put(act), sm.device_put(prev)
+    new, ent, lv, total = step(xs, zs, rs, acts, prevs)
+
+    # sharding actually partitions the space axis
+    assert len(new.sharding.device_set) == 8
+
+    nd, ed, ld = aoi_step_dense_batched(
+        jnp.asarray(x), jnp.asarray(z), jnp.asarray(r), jnp.asarray(act),
+        jnp.asarray(prev),
+    )
+    np.testing.assert_array_equal(np.asarray(new), np.asarray(nd))
+    np.testing.assert_array_equal(np.asarray(ent), np.asarray(ed))
+    np.testing.assert_array_equal(np.asarray(lv), np.asarray(ld))
+
+    import jax.lax
+    expect = int(
+        np.asarray(
+            jnp.sum(jax.lax.population_count(ed)) + jnp.sum(jax.lax.population_count(ld))
+        )
+    )
+    assert int(total) == expect and expect > 0

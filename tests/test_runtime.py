@@ -168,7 +168,10 @@ def test_timers_fire_and_survive_dump_restore():
     rt.tick()
     assert calls.count("rep") >= 1
     dumped = p.dump_timers()
-    assert ["greet", 2.0, True, ("rep",)] in [list(d) for d in dumped]
+    assert [d[:4] for d in dumped] == [["greet", 2.0, True, ("rep",)]]
+    # dump records time REMAINING so restore keeps the phase: next fire was
+    # scheduled for t=6.5, dumped at t=4.5 -> remaining 2.0
+    assert dumped[0][4] == pytest.approx(2.0)
 
 
 def test_migrate_data_roundtrip():
@@ -183,7 +186,31 @@ def test_migrate_data_roundtrip():
     b = rt.entities.restore(data)
     assert b.id == a.id and b.attrs.get_str("name") == "mig"
     assert b.position.to_tuple() == (5.0, 1.0, 5.0)
-    assert b.dump_timers() == [["say", 3.0, True, ("x",)]]
+    assert [d[:4] for d in b.dump_timers()] == [["say", 3.0, True, ("x",)]]
+
+
+def test_timer_restore_preserves_phase():
+    """A timer dumped 59s into a 60s delay fires ~1s after restore, not 60s
+    (reference behavior: FireTime - now)."""
+    t = [0.0]
+    rt = Runtime(aoi_backend="cpu", now=lambda: t[0])
+    rt.entities.register(MyScene)
+    rt.entities.register(Player)
+    scene = rt.entities.create_space("MyScene")
+    scene.enable_aoi(10)
+    p = rt.entities.create("Player", space=scene, pos=Vector3())
+    calls = []
+    p.boom = lambda: calls.append("boom")
+    p.add_callback(60.0, "boom")
+    t[0] = 59.0
+    data = p.migrate_data()
+    assert data["timers"][0][4] == pytest.approx(1.0)
+    p._destroy_impl(is_migrate=True)
+    q = rt.entities.restore(data)
+    q.boom = lambda: calls.append("boom")
+    t[0] = 60.5  # 1.5s after restore point
+    rt.tick()
+    assert calls == ["boom"]
 
 
 def test_space_capacity_growth_preserves_interest():
