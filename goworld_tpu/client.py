@@ -1,0 +1,159 @@
+"""Headless game client: full client-side protocol implementation.
+
+Reference role: examples/test_client (ClientBot.go / ClientEntity.go) -- the
+bot client that mirrors server entities from the wire protocol; used by e2e
+tests as strict protocol assertions and by users as the client SDK model.
+
+Maintains:
+  * ``entities``: id -> ClientEntity mirrors built from create/destroy ops;
+  * attr mirrors updated via the delta stream (attrs.apply_delta);
+  * positions updated from batched sync records;
+  * the player (own) entity, re-bound on ownership handoff.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .engine.attrs import MapAttr, apply_delta
+from .netutil import Packet, PacketConnection, connect_tcp
+from .proto import msgtypes as MT
+
+
+class ClientEntity:
+    def __init__(self, type_name: str, eid: str, is_player: bool,
+                 attrs: dict, pos: tuple, yaw: float):
+        self.type_name = type_name
+        self.id = eid
+        self.is_player = is_player
+        self.attrs = MapAttr(attrs)
+        self.position = pos
+        self.yaw = yaw
+        self.calls: list[tuple] = []  # (method, args) received from server
+
+    def __repr__(self):
+        return f"<client-mirror {self.type_name}:{self.id}{' (player)' if self.is_player else ''}>"
+
+
+class GameClientConnection:
+    """A connected client.  ``poll()`` drains pending server messages on the
+    caller's thread (no background threads -- deterministic for tests)."""
+
+    def __init__(self, addr: tuple[str, int], compression: str = "gwlz"):
+        self.pc = PacketConnection(connect_tcp(addr), compression=compression)
+        self.client_id: str | None = None
+        self.entities: dict[str, ClientEntity] = {}
+        self.player: ClientEntity | None = None
+        self.filtered_calls: list[tuple] = []
+        self._lock = threading.Lock()
+        self.pc._sock.settimeout(0.01)
+
+    # -- receive -----------------------------------------------------------
+    def poll(self, duration: float = 0.0) -> int:
+        """Process everything available (for up to ``duration`` seconds);
+        returns number of packets handled."""
+        deadline = time.monotonic() + duration
+        n = 0
+        while True:
+            try:
+                pkt = self.pc.recv_packet()
+            except TimeoutError:
+                pkt = None
+            except OSError:
+                break
+            if pkt is not None:
+                self._handle(pkt)
+                n += 1
+                continue
+            if time.monotonic() >= deadline:
+                break
+        return n
+
+    def wait_for(self, predicate, timeout: float = 5.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.poll(0.02)
+            if predicate(self):
+                return True
+        return False
+
+    def _handle(self, pkt: Packet):
+        msgtype = pkt.read_u16()
+        if msgtype == MT.MT_CLIENT_HANDSHAKE:
+            self.client_id = pkt.read_client_id()
+        elif msgtype == MT.MT_CREATE_ENTITY_ON_CLIENT:
+            type_name = pkt.read_varstr()
+            eid = pkt.read_entity_id()
+            is_player = pkt.read_bool()
+            attrs = pkt.read_data()
+            pos = (pkt.read_f32(), pkt.read_f32(), pkt.read_f32())
+            yaw = pkt.read_f32()
+            e = ClientEntity(type_name, eid, is_player, attrs or {}, pos, yaw)
+            self.entities[eid] = e
+            if is_player:
+                self.player = e
+        elif msgtype == MT.MT_DESTROY_ENTITY_ON_CLIENT:
+            _type_name = pkt.read_varstr()
+            eid = pkt.read_entity_id()
+            e = self.entities.pop(eid, None)
+            if e is not None and self.player is e:
+                self.player = None
+        elif msgtype == MT.MT_NOTIFY_ATTR_CHANGE_ON_CLIENT:
+            eid = pkt.read_entity_id()
+            d = pkt.read_data()
+            e = self.entities.get(eid)
+            if e is not None:
+                apply_delta(e.attrs, tuple(d["p"]), d["o"], d["v"])
+        elif msgtype == MT.MT_CALL_ENTITY_METHOD_ON_CLIENT:
+            eid = pkt.read_entity_id()
+            method = pkt.read_varstr()
+            args = pkt.read_args()
+            e = self.entities.get(eid)
+            if e is not None:
+                e.calls.append((method, args))
+        elif msgtype == MT.MT_SYNC_POSITION_YAW_ON_CLIENTS:
+            while pkt.remaining() > 0:
+                eid = pkt.read_entity_id()
+                x, y, z = pkt.read_f32(), pkt.read_f32(), pkt.read_f32()
+                yaw = pkt.read_f32()
+                e = self.entities.get(eid)
+                if e is not None:
+                    e.position = (x, y, z)
+                    e.yaw = yaw
+        elif msgtype == MT.MT_CALL_FILTERED_CLIENTS:
+            method = pkt.read_varstr()
+            args = pkt.read_args()
+            self.filtered_calls.append((method, args))
+
+    # -- send --------------------------------------------------------------
+    def call_server(self, eid: str, method: str, *args):
+        p = Packet.for_msgtype(MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT)
+        p.append_entity_id(eid)
+        p.append_varstr(method)
+        p.append_args(args)
+        self.pc.send_packet(p)
+        self.pc.flush()
+
+    def call_player(self, method: str, *args):
+        if self.player is None:
+            raise RuntimeError("no player entity yet")
+        self.call_server(self.player.id, method, *args)
+
+    def send_position(self, x: float, y: float, z: float, yaw: float = 0.0):
+        if self.player is None:
+            return
+        p = Packet.for_msgtype(MT.MT_SYNC_POSITION_YAW_FROM_CLIENT)
+        p.append_entity_id(self.player.id)
+        import struct
+
+        p.append_bytes(struct.pack("<ffff", x, y, z, yaw))
+        self.pc.send_packet(p)
+        self.pc.flush()
+
+    def heartbeat(self):
+        self.pc.send_packet(Packet.for_msgtype(MT.MT_HEARTBEAT))
+        self.pc.flush()
+
+    def close(self):
+        self.pc.close()

@@ -1,0 +1,529 @@
+"""Dispatcher: the cluster's message router.
+
+Reference: components/dispatcher/DispatcherService.go.  Single consumer loop
+over a packet queue fed by per-connection recv threads; owns:
+
+  * the entity location directory (eid -> game) with block/replay queues --
+    the delivery-ordering mechanism across entity loads and migrations
+    (reference: entityDispatchInfo, DispatcherService.go:28-80);
+  * game-level blocking for freeze/hot-reload (gameDispatchInfo, :82-169);
+  * boot-entity round-robin and least-loaded-game placement (LBC min-heap,
+    :529-558, lbcheap.go);
+  * the deployment readiness barrier (:446-476);
+  * the srvdis registry mirror (:737-751);
+  * broadcast primitives (games / gates / nil-spaces / filtered clients).
+"""
+
+from __future__ import annotations
+
+import heapq
+import queue
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ...config import ClusterConfig
+from ...netutil import Packet, PacketConnection, serve_tcp
+from ...proto import msgtypes as MT
+from ...utils import gwlog
+
+BLOCKED_ENTITY_QUEUE_MAX = 1000      # reference: consts.go:32
+BLOCKED_GAME_QUEUE_MAX = 1_000_000   # reference: consts.go:30
+MIGRATE_BLOCK_TIMEOUT = 60.0
+LOAD_BLOCK_TIMEOUT = 10.0
+FREEZE_BLOCK_TIMEOUT = 10.0
+
+
+@dataclass
+class _EntityInfo:
+    game_id: int = 0
+    block_until: float = 0.0
+    pending: deque = field(default_factory=deque)
+
+    def blocked(self, now: float) -> bool:
+        return self.block_until > now
+
+
+@dataclass
+class _GameInfo:
+    conn: "object | None" = None  # _Peer
+    block_until: float = 0.0
+    pending: deque = field(default_factory=deque)
+    frozen: bool = False
+    load: float = 0.0
+
+
+class _Peer:
+    """One accepted connection (game or gate)."""
+
+    def __init__(self, pc: PacketConnection):
+        self.pc = pc
+        self.kind = "?"  # "game" | "gate"
+        self.id = 0
+        self.alive = True
+
+    def send(self, p: Packet, release=False):
+        if self.alive:
+            try:
+                self.pc.send_packet(p, release=release)
+            except OSError:
+                self.alive = False
+
+    def send_payload(self, payload: bytes):
+        if self.alive:
+            try:
+                self.pc.send_packet(Packet(bytearray(payload)))
+            except OSError:
+                self.alive = False
+
+
+class DispatcherService:
+    def __init__(self, disp_id: int, cfg: ClusterConfig):
+        self.id = disp_id
+        self.cfg = cfg
+        dc = cfg.dispatchers[disp_id]
+        self.addr = (dc.host, dc.port)
+        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=100000)
+        self.games: dict[int, _GameInfo] = {}
+        self.gates: dict[int, _Peer] = {}
+        self.entities: dict[str, _EntityInfo] = {}
+        self.srvdis: dict[str, str] = {}
+        self.ready = False
+        self._blocked_eids: set[str] = set()  # entities with block/pending state
+        self._boot_rr = 0
+        self._listener = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.log = gwlog.logger(f"dispatcher{disp_id}")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._listener = serve_tcp(self.addr, self._on_connection)
+        self.addr = self._listener.getsockname()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self.log.info("dispatcher listening on %s", self.addr)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._listener:
+            self._listener.close()
+
+    def _on_connection(self, sock, peer_addr):
+        pc = PacketConnection(sock)
+        peer = _Peer(pc)
+        while True:
+            try:
+                pkt = pc.recv_packet()
+            except (OSError, ValueError):
+                pkt = None
+            if pkt is None:
+                self.queue.put(("disconnect", peer, None))
+                return
+            self.queue.put(("packet", peer, pkt))
+
+    # -- main loop ---------------------------------------------------------
+    def _run(self):
+        flush_deadline = time.monotonic() + 0.005
+        while not self._stop.is_set():
+            timeout = max(0.0, flush_deadline - time.monotonic())
+            try:
+                kind, peer, pkt = self.queue.get(timeout=timeout)
+            except queue.Empty:
+                kind = None
+            if kind == "packet":
+                try:
+                    self._handle(peer, pkt)
+                except Exception:
+                    self.log.exception("handler error")
+            elif kind == "disconnect":
+                self._on_disconnect(peer)
+            now = time.monotonic()
+            if now >= flush_deadline:
+                self._flush_all()
+                self._check_unblock(now)
+                flush_deadline = now + 0.005
+
+    def _flush_all(self):
+        for gi in self.games.values():
+            if gi.conn is not None and gi.conn.alive:
+                try:
+                    gi.conn.pc.flush()
+                except OSError:
+                    gi.conn.alive = False
+        for gate in self.gates.values():
+            if gate.alive:
+                try:
+                    gate.pc.flush()
+                except OSError:
+                    gate.alive = False
+
+    # -- handlers ----------------------------------------------------------
+    def _handle(self, peer: _Peer, pkt: Packet):
+        msgtype = pkt.read_u16()
+        if MT.is_redirect_to_client(msgtype) or msgtype == MT.MT_SYNC_POSITION_YAW_ON_CLIENTS:
+            gate_id = pkt.read_u16()
+            gate = self.gates.get(gate_id)
+            if gate:
+                gate.send_payload(pkt.payload)
+            return
+        handler = self._HANDLERS.get(msgtype)
+        if handler is None:
+            self.log.warning("unknown msgtype %s", msgtype)
+            return
+        handler(self, peer, pkt)
+
+    def _h_set_game_id(self, peer, pkt):
+        gid = pkt.read_u16()
+        is_restore = pkt.read_bool()
+        n = pkt.read_u32()
+        eids = [pkt.read_entity_id() for _ in range(n)]
+        peer.kind, peer.id = "game", gid
+        gi = self.games.setdefault(gid, _GameInfo())
+        gi.conn = peer
+        # reconcile directory: entities the game claims that now map elsewhere
+        # are rejected back (reference: DispatcherService.go:376-398)
+        for eid in eids:
+            ei = self.entities.setdefault(eid, _EntityInfo())
+            ei.game_id = gid
+        if is_restore and gi.frozen:
+            gi.frozen = False
+            self._unblock_game(gi)
+        self.log.info("game%d connected (%d entities, restore=%s)", gid, n, is_restore)
+        self._check_ready()
+
+    def _h_set_gate_id(self, peer, pkt):
+        gate_id = pkt.read_u16()
+        peer.kind, peer.id = "gate", gate_id
+        self.gates[gate_id] = peer
+        self.log.info("gate%d connected", gate_id)
+        self._check_ready()
+
+    def _check_ready(self):
+        want_games = len(self.cfg.games)
+        want_gates = len(self.cfg.gates)
+        have_games = sum(
+            1 for gi in self.games.values() if gi.conn and gi.conn.alive
+        )
+        have_gates = sum(1 for g in self.gates.values() if g.alive)
+        if not self.ready and have_games >= want_games and have_gates >= want_gates:
+            self.ready = True
+            p = Packet.for_msgtype(MT.MT_NOTIFY_DEPLOYMENT_READY)
+            self._broadcast_games(p)
+            for gate in self.gates.values():
+                gate.send_payload(p.payload)
+            self.log.info("deployment ready (%d games, %d gates)", have_games, have_gates)
+
+    def _h_notify_create_entity(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        ei = self.entities.setdefault(eid, _EntityInfo())
+        ei.game_id = peer.id
+        self._unblock_entity(eid, ei)
+
+    def _h_notify_destroy_entity(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        self.entities.pop(eid, None)
+
+    def _h_notify_client_connected(self, peer, pkt):
+        # gate generated the boot entity id; pick a game round-robin
+        # (reference: chooseGameForBootEntity, :545-558)
+        client_id = pkt.read_client_id()
+        boot_eid = pkt.read_entity_id()
+        gids = sorted(
+            gid for gid, gi in self.games.items()
+            if gi.conn and gi.conn.alive and not gi.frozen
+        )
+        if not gids:
+            self.log.error("no game available for boot entity")
+            return
+        gid = gids[self._boot_rr % len(gids)]
+        self._boot_rr += 1
+        ei = self.entities.setdefault(boot_eid, _EntityInfo())
+        ei.game_id = gid
+        out = Packet.for_msgtype(MT.MT_NOTIFY_CLIENT_CONNECTED)
+        out.append_client_id(client_id)
+        out.append_entity_id(boot_eid)
+        out.append_u16(peer.id)  # gate id appended for the game
+        self._send_to_game(gid, out)
+
+    def _h_notify_client_disconnected(self, peer, pkt):
+        client_id = pkt.read_client_id()
+        owner_eid = pkt.read_entity_id()
+        ei = self.entities.get(owner_eid)
+        if ei and ei.game_id:
+            out = Packet.for_msgtype(MT.MT_NOTIFY_CLIENT_DISCONNECTED)
+            out.append_client_id(client_id)
+            out.append_entity_id(owner_eid)
+            self._send_to_game(ei.game_id, out)
+
+    def _h_create_entity_anywhere(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        # least-loaded placement with virtual-load nudge
+        # (reference: :529-542 + lbcheap)
+        gid = self._pick_least_loaded_game()
+        if gid == 0:
+            self.log.error("no game for create-anywhere")
+            return
+        ei = self.entities.setdefault(eid, _EntityInfo())
+        ei.game_id = gid
+        ei.block_until = time.monotonic() + LOAD_BLOCK_TIMEOUT
+        self._blocked_eids.add(eid)
+        self._send_to_game(gid, Packet(bytearray(pkt.payload)))
+
+    def _h_load_entity_anywhere(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        ei = self.entities.setdefault(eid, _EntityInfo())
+        if ei.game_id == 0:
+            gid = self._pick_least_loaded_game()
+            if gid == 0:
+                return
+            ei.game_id = gid
+            # block calls until the game reports NOTIFY_CREATE_ENTITY
+            # (reference: :682-711)
+            ei.block_until = time.monotonic() + LOAD_BLOCK_TIMEOUT
+            self._blocked_eids.add(eid)
+            self._send_to_game(gid, Packet(bytearray(pkt.payload)))
+        # already loaded/loading: nothing to do
+
+    def _pick_least_loaded_game(self) -> int:
+        best, best_load = 0, None
+        for gid, gi in sorted(self.games.items()):
+            if gi.conn is None or not gi.conn.alive or gi.frozen:
+                continue
+            jitter = gi.load * random.uniform(1.0, 1.1)
+            if best_load is None or jitter < best_load:
+                best, best_load = gid, jitter
+        if best:
+            self.games[best].load += 0.1  # virtual-load nudge per pick
+        return best
+
+    def _h_game_lbc_info(self, peer, pkt):
+        load = pkt.read_f32()
+        gi = self.games.get(peer.id)
+        if gi:
+            gi.load = load
+
+    def _h_call_entity_method(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        self._dispatch_entity_packet(eid, pkt)
+
+    _h_call_entity_method_from_client = _h_call_entity_method
+
+    def _h_call_nil_spaces(self, peer, pkt):
+        exclude = pkt.read_u16()
+        for gid, gi in self.games.items():
+            if gid != exclude and gi.conn and gi.conn.alive:
+                self._send_to_game(gid, Packet(bytearray(pkt.payload)))
+
+    def _h_sync_from_client(self, peer, pkt):
+        """Flat array of (eid, x, y, z, yaw) from a gate; regroup per game
+        (reference: DispatcherService.go:789-827)."""
+        per_game: dict[int, Packet] = {}
+        while pkt.remaining() > 0:
+            eid = pkt.read_entity_id()
+            rec = pkt.read_bytes(16)
+            ei = self.entities.get(eid)
+            if ei is None or ei.game_id == 0:
+                continue
+            out = per_game.get(ei.game_id)
+            if out is None:
+                out = Packet.for_msgtype(MT.MT_SYNC_POSITION_YAW_FROM_CLIENT)
+                per_game[ei.game_id] = out
+            out.append_entity_id(eid)
+            out.append_bytes(rec)
+        for gid, out in per_game.items():
+            self._send_to_game(gid, out)
+
+    # -- migration ---------------------------------------------------------
+    def _h_query_space_gameid_for_migrate(self, peer, pkt):
+        space_id = pkt.read_entity_id()
+        eid = pkt.read_entity_id()
+        ei = self.entities.get(space_id)
+        out = Packet.for_msgtype(MT.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE)
+        out.append_entity_id(space_id)
+        out.append_entity_id(eid)
+        out.append_u16(ei.game_id if ei else 0)
+        peer.send(out)
+
+    def _h_migrate_request(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        space_id = pkt.read_entity_id()
+        space_game = pkt.read_u16()
+        ei = self.entities.setdefault(eid, _EntityInfo())
+        ei.block_until = time.monotonic() + MIGRATE_BLOCK_TIMEOUT
+        self._blocked_eids.add(eid)
+        out = Packet.for_msgtype(MT.MT_MIGRATE_REQUEST)
+        out.append_entity_id(eid)
+        out.append_entity_id(space_id)
+        out.append_u16(space_game)
+        peer.send(out)
+
+    def _h_real_migrate(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        target_game = pkt.read_u16()
+        ei = self.entities.setdefault(eid, _EntityInfo())
+        ei.game_id = target_game
+        self._send_to_game(target_game, Packet(bytearray(pkt.payload)))
+        self._unblock_entity(eid, ei)
+
+    def _h_cancel_migrate(self, peer, pkt):
+        eid = pkt.read_entity_id()
+        ei = self.entities.get(eid)
+        if ei:
+            self._unblock_entity(eid, ei)
+
+    # -- srvdis ------------------------------------------------------------
+    def _h_srvdis_register(self, peer, pkt):
+        srvid = pkt.read_varstr()
+        info = pkt.read_varstr()
+        force = pkt.read_bool()
+        if force or srvid not in self.srvdis:
+            self.srvdis[srvid] = info  # first-writer-wins (reference :737-751)
+            out = Packet.for_msgtype(MT.MT_SRVDIS_UPDATE)
+            out.append_varstr(srvid)
+            out.append_varstr(self.srvdis[srvid])
+            self._broadcast_games(out)
+        else:
+            # already registered: send current registration back to requester
+            out = Packet.for_msgtype(MT.MT_SRVDIS_UPDATE)
+            out.append_varstr(srvid)
+            out.append_varstr(self.srvdis[srvid])
+            peer.send(out)
+
+    # -- freeze ------------------------------------------------------------
+    def _h_start_freeze_game(self, peer, pkt):
+        gi = self.games.get(peer.id)
+        if gi is None:
+            return
+        gi.frozen = True
+        gi.block_until = time.monotonic() + FREEZE_BLOCK_TIMEOUT
+        peer.send(Packet.for_msgtype(MT.MT_START_FREEZE_GAME_ACK))
+
+    # -- filtered clients --------------------------------------------------
+    def _h_call_filtered_clients(self, peer, pkt):
+        for gate in self.gates.values():
+            gate.send_payload(pkt.payload)
+
+    def _h_set_filter_prop(self, peer, pkt):
+        gate_id = pkt.read_u16()
+        gate = self.gates.get(gate_id)
+        if gate:
+            gate.send_payload(pkt.payload)
+
+    _h_clear_filter_props = _h_set_filter_prop
+
+    # -- routing helpers ---------------------------------------------------
+    def _dispatch_entity_packet(self, eid: str, pkt: Packet):
+        """Route a packet to the entity's game, queuing while blocked
+        (the ordering guarantee -- reference dispatchPacket, :34-80)."""
+        ei = self.entities.get(eid)
+        now = time.monotonic()
+        if ei is None or ei.game_id == 0:
+            return  # no such entity known; drop (reference logs similarly)
+        # also queue while older packets are still pending (a block that just
+        # expired must not let new packets overtake the queued ones)
+        if ei.blocked(now) or ei.pending:
+            if len(ei.pending) < BLOCKED_ENTITY_QUEUE_MAX:
+                ei.pending.append(pkt.payload)
+                self._blocked_eids.add(eid)
+            return
+        self._send_to_game(ei.game_id, Packet(bytearray(pkt.payload)))
+
+    def _send_to_game(self, gid: int, pkt: Packet):
+        gi = self.games.get(gid)
+        if gi is None:
+            return
+        now = time.monotonic()
+        if gi.frozen or gi.conn is None or not gi.conn.alive:
+            if gi.frozen or gi.block_until > now:
+                if len(gi.pending) < BLOCKED_GAME_QUEUE_MAX:
+                    gi.pending.append(pkt.payload)
+            return
+        gi.conn.send(pkt)
+
+    def _broadcast_games(self, pkt: Packet, exclude: int = 0):
+        for gid, gi in self.games.items():
+            if gid != exclude:
+                self._send_to_game(gid, Packet(bytearray(pkt.payload)))
+
+    def _unblock_entity(self, eid: str, ei: _EntityInfo):
+        ei.block_until = 0.0
+        while ei.pending:
+            payload = ei.pending.popleft()
+            self._send_to_game(ei.game_id, Packet(bytearray(payload)))
+        self._blocked_eids.discard(eid)
+
+    def _unblock_game(self, gi: _GameInfo):
+        gi.block_until = 0.0
+        while gi.pending and gi.conn and gi.conn.alive:
+            payload = gi.pending.popleft()
+            gi.conn.send_payload(payload)
+
+    def _check_unblock(self, now: float):
+        # only entities with block/pending state are tracked -- the full
+        # directory is never scanned on the 5 ms tick
+        for eid in list(self._blocked_eids):
+            ei = self.entities.get(eid)
+            if ei is None:
+                self._blocked_eids.discard(eid)
+            elif ei.pending and not ei.blocked(now):
+                self._unblock_entity(eid, ei)
+
+    # -- disconnects -------------------------------------------------------
+    def _on_disconnect(self, peer: _Peer):
+        peer.alive = False
+        if peer.kind == "game":
+            gi = self.games.get(peer.id)
+            if gi and gi.conn is peer:
+                gi.conn = None
+                if gi.frozen:
+                    # freeze in progress: keep queueing until restore
+                    self.log.info("game%d frozen, awaiting restore", peer.id)
+                    return
+                # clean directory; notify everyone
+                # (reference: :595-643)
+                dead = [
+                    eid for eid, ei in self.entities.items()
+                    if ei.game_id == peer.id
+                ]
+                for eid in dead:
+                    del self.entities[eid]
+                out = Packet.for_msgtype(MT.MT_NOTIFY_GAME_DISCONNECTED)
+                out.append_u16(peer.id)
+                self._broadcast_games(out, exclude=peer.id)
+                self.log.info("game%d disconnected (%d entities dropped)", peer.id, len(dead))
+        elif peer.kind == "gate":
+            if self.gates.get(peer.id) is peer:
+                del self.gates[peer.id]
+                out = Packet.for_msgtype(MT.MT_NOTIFY_GATE_DISCONNECTED)
+                out.append_u16(peer.id)
+                self._broadcast_games(out)
+                self.log.info("gate%d disconnected", peer.id)
+
+    _HANDLERS = {
+        MT.MT_SET_GAME_ID: _h_set_game_id,
+        MT.MT_SET_GATE_ID: _h_set_gate_id,
+        MT.MT_NOTIFY_CREATE_ENTITY: _h_notify_create_entity,
+        MT.MT_NOTIFY_DESTROY_ENTITY: _h_notify_destroy_entity,
+        MT.MT_NOTIFY_CLIENT_CONNECTED: _h_notify_client_connected,
+        MT.MT_NOTIFY_CLIENT_DISCONNECTED: _h_notify_client_disconnected,
+        MT.MT_CREATE_ENTITY_ANYWHERE: _h_create_entity_anywhere,
+        MT.MT_LOAD_ENTITY_ANYWHERE: _h_load_entity_anywhere,
+        MT.MT_CALL_ENTITY_METHOD: _h_call_entity_method,
+        MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT: _h_call_entity_method_from_client,
+        MT.MT_CALL_NIL_SPACES: _h_call_nil_spaces,
+        MT.MT_SYNC_POSITION_YAW_FROM_CLIENT: _h_sync_from_client,
+        MT.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE: _h_query_space_gameid_for_migrate,
+        MT.MT_MIGRATE_REQUEST: _h_migrate_request,
+        MT.MT_REAL_MIGRATE: _h_real_migrate,
+        MT.MT_CANCEL_MIGRATE: _h_cancel_migrate,
+        MT.MT_SRVDIS_REGISTER: _h_srvdis_register,
+        MT.MT_START_FREEZE_GAME: _h_start_freeze_game,
+        MT.MT_CALL_FILTERED_CLIENTS: _h_call_filtered_clients,
+        MT.MT_SET_CLIENTPROXY_FILTER_PROP: _h_set_filter_prop,
+        MT.MT_CLEAR_CLIENTPROXY_FILTER_PROPS: _h_clear_filter_props,
+        MT.MT_GAME_LBC_INFO: _h_game_lbc_info,
+    }

@@ -1,0 +1,560 @@
+"""Game service: hosts the entity runtime inside the cluster fabric.
+
+Reference: components/game (game.go boot sequence, GameService.go main loop).
+One logic thread drains the packet queue and runs the Runtime tick phases;
+recv threads only enqueue (the reference's single-goroutine invariant).
+
+Outbound plumbing per tick:
+  * entity register/unregister -> MT_NOTIFY_CREATE/DESTROY_ENTITY (directory);
+  * GameClient outboxes -> redirect-band packets to the owning gate;
+  * position sync records -> per-gate MT_SYNC_POSITION_YAW_ON_CLIENTS batches
+    (reference: CollectEntitySyncInfos, Entity.go:1221-1267);
+  * remote RPC -> MT_CALL_ENTITY_METHOD via the entity's dispatcher shard.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+from ...config import ClusterConfig
+from ...dispatchercluster import DispatcherCluster
+from ...engine.entity import Entity, GameClient
+from ...engine.ids import fixed_id, gen_id
+from ...engine.runtime import Runtime
+from ...engine.space import Space
+from ...engine.vector import Vector3
+from ...netutil import Packet
+from ...proto import GWConnection, msgtypes as MT
+from ...utils import gwlog, gwutils
+
+
+class NilSpace(Space):
+    """Kindless per-game space (reference: Space.go:127-140); entities live
+    here logically when not in a real space; receives OnGameReady."""
+
+
+class GameService:
+    def __init__(self, game_id: int, cfg: ClusterConfig, freeze_dir: str = "."):
+        self.id = game_id
+        self.cfg = cfg
+        self.gcfg = cfg.games[game_id]
+        self.freeze_dir = freeze_dir
+        self.log = gwlog.logger(f"game{game_id}")
+        self.rt = Runtime(
+            aoi_backend=self.gcfg.aoi_backend,
+            on_error=lambda e: self.log.exception("entity error", exc_info=e),
+        )
+        self.rt.on_entity_registered = self._on_entity_registered
+        self.rt.on_entity_unregistered = self._on_entity_unregistered
+        self.rt.game = self  # entities reach cluster ops through this
+        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=100000)
+        self.cluster = DispatcherCluster(
+            cfg.dispatcher_addrs(),
+            on_packet=lambda i, p: self.queue.put((i, p)),
+            register=self._register_to_dispatcher,
+            tag=f"game{game_id}",
+        )
+        self.nil_space: NilSpace | None = None
+        self.deployment_ready = False
+        self.srvmap: dict[str, str] = {}
+        self.on_srvdis_update = None  # service layer hook
+        self._migrating: dict[str, dict] = {}  # eid -> {"space_id","pos"}
+        self._freeze_acks_wanted = 0
+        self._freeze_acks = 0
+        self._frozen_file = os.path.join(self.freeze_dir, f"game{game_id}_frozen.dat")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._registering_suppressed = False
+        self.rt.entities.register(NilSpace, "__nil_space__")
+
+    # -- boot --------------------------------------------------------------
+    def register_entity_type(self, cls, name=None):
+        return self.rt.entities.register(cls, name)
+
+    def start(self, restore: bool = False):
+        self._is_restore = restore
+        if restore and os.path.exists(self._frozen_file):
+            self._restore_from_freeze()
+        else:
+            self.nil_space = self.rt.entities.create(  # type: ignore[assignment]
+                "__nil_space__", eid=fixed_id(f"nilspace-game{self.id}")
+            )
+        self.cluster.start()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        gwlog.announce_ready(f"game{self.id}", "game")
+        return self
+
+    def stop(self, save: bool = True):
+        """Graceful terminate (reference: SIGTERM path, GameService.go:200-219):
+        save persistent entities (when storage is attached), destroy all with
+        hooks, then drop the cluster links."""
+        storage = getattr(self, "storage", None)
+        for e in list(self.rt.entities.entities.values()):
+            if save and storage is not None and e.persistent:
+                storage.save(e.type_name, e.id, e.persistent_data())
+            gwutils.run_panicless(e.destroy, logger=self.log)
+        if storage is not None:
+            storage.wait_idle(5.0)
+        self._stop.set()
+        self.cluster.stop()
+
+    def _register_to_dispatcher(self, conn: GWConnection):
+        eids = list(self.rt.entities.entities.keys())
+        # is_restore unblocks the dispatcher's frozen-game queue after a
+        # hot reload (reference: reconnect-with-restore, GameService freeze)
+        conn.send_set_game_id(self.id, getattr(self, "_is_restore", False), eids)
+
+    # -- logic loop --------------------------------------------------------
+    def _run(self):
+        tick_s = self.gcfg.tick_interval_ms / 1000.0
+        sync_s = self.gcfg.position_sync_interval_ms / 1000.0
+        next_tick = time.monotonic() + tick_s
+        next_sync = time.monotonic() + sync_s
+        while not self._stop.is_set():
+            timeout = max(0.0, next_tick - time.monotonic())
+            try:
+                i, pkt = self.queue.get(timeout=timeout)
+                gwutils.run_panicless(self._handle, pkt, logger=self.log)
+            except queue.Empty:
+                pass
+            now = time.monotonic()
+            if now >= next_tick:
+                gwutils.run_panicless(self.rt.tick, logger=self.log)
+                self._drain_client_outboxes()
+                if now >= next_sync:
+                    self._send_position_syncs()
+                    next_sync = now + sync_s
+                self.cluster.flush_all()
+                next_tick = now + tick_s
+
+    def step(self, n: int = 1):
+        """Synchronous tick driver for tests (no background thread)."""
+        for _ in range(n):
+            while True:
+                try:
+                    i, pkt = self.queue.get_nowait()
+                except queue.Empty:
+                    break
+                gwutils.run_panicless(self._handle, pkt, logger=self.log)
+            self.rt.tick()
+            self._drain_client_outboxes()
+            self._send_position_syncs()
+            self.cluster.flush_all()
+
+    # -- inbound handlers --------------------------------------------------
+    def _handle(self, pkt: Packet):
+        msgtype = pkt.read_u16()
+        h = self._HANDLERS.get(msgtype)
+        if h is None:
+            self.log.warning("unhandled msgtype %d", msgtype)
+            return
+        h(self, pkt)
+
+    def _h_deployment_ready(self, pkt):
+        if self.deployment_ready:
+            return
+        self.deployment_ready = True
+        self.log.info("deployment ready")
+        for e in list(self.rt.entities.entities.values()):
+            gwutils.run_panicless(e.on_game_ready, logger=self.log)
+
+    def _h_client_connected(self, pkt):
+        client_id = pkt.read_client_id()
+        boot_eid = pkt.read_entity_id()
+        gate_id = pkt.read_u16()
+        boot_type = self.gcfg.boot_entity
+        if not boot_type:
+            self.log.error("no boot_entity configured")
+            return
+        e = self.rt.entities.create(boot_type, eid=boot_eid)
+        e.set_client(GameClient(client_id, gate_id))
+
+    def _h_client_disconnected(self, pkt):
+        client_id = pkt.read_client_id()
+        owner_eid = pkt.read_entity_id()
+        e = self.rt.entities.get(owner_eid)
+        if e is not None and e.client is not None and e.client.client_id == client_id:
+            e.client = None
+            gwutils.run_panicless(e.on_client_disconnected, logger=self.log)
+
+    def _h_call_entity_method(self, pkt):
+        eid = pkt.read_entity_id()
+        method = pkt.read_varstr()
+        args = pkt.read_args()
+        e = self.rt.entities.get(eid)
+        if e is None:
+            self.log.warning("call %s on missing entity %s", method, eid)
+            return
+        gwutils.run_panicless(e.call, method, *args, logger=self.log)
+
+    def _h_call_entity_method_from_client(self, pkt):
+        eid = pkt.read_entity_id()
+        method = pkt.read_varstr()
+        args = pkt.read_args()
+        client_id = pkt.read_client_id()
+        e = self.rt.entities.get(eid)
+        if e is None:
+            return
+        gwutils.run_panicless(
+            e.on_call_from_client, method, args, client_id, logger=self.log
+        )
+
+    def _h_call_nil_spaces(self, pkt):
+        _exclude = pkt.read_u16()
+        method = pkt.read_varstr()
+        args = pkt.read_args()
+        if self.nil_space is not None:
+            gwutils.run_panicless(self.nil_space.call, method, *args, logger=self.log)
+
+    def _h_sync_from_client(self, pkt):
+        while pkt.remaining() > 0:
+            eid = pkt.read_entity_id()
+            x = pkt.read_f32()
+            y = pkt.read_f32()
+            z = pkt.read_f32()
+            yaw = pkt.read_f32()
+            e = self.rt.entities.get(eid)
+            if e is not None:
+                e.sync_position_yaw_from_client(Vector3(x, y, z), yaw)
+
+    def _h_create_entity_anywhere(self, pkt):
+        eid = pkt.read_entity_id()
+        type_name = pkt.read_varstr()
+        attrs = pkt.read_data()
+        self.rt.entities.create(type_name, eid=eid, attrs=attrs or {})
+
+    def _h_load_entity_anywhere(self, pkt):
+        eid = pkt.read_entity_id()
+        type_name = pkt.read_varstr()
+        storage = getattr(self, "storage", None)
+        if storage is None:
+            self.log.error("load_entity: no storage attached")
+            return
+        def on_loaded(data):
+            if data is None:
+                self.log.warning("load_entity: %s/%s not found", type_name, eid)
+                return
+            if self.rt.entities.get(eid) is None:
+                self.rt.entities.create(type_name, eid=eid, attrs=data or {})
+        storage.load(type_name, eid, on_loaded)
+
+    def _h_srvdis_update(self, pkt):
+        srvid = pkt.read_varstr()
+        info = pkt.read_varstr()
+        self.srvmap[srvid] = info
+        if self.on_srvdis_update is not None:
+            gwutils.run_panicless(self.on_srvdis_update, srvid, info, logger=self.log)
+
+    # migration (§3.4)
+    def _h_query_space_gameid_ack(self, pkt):
+        space_id = pkt.read_entity_id()
+        eid = pkt.read_entity_id()
+        space_game = pkt.read_u16()
+        mig = self._migrating.get(eid)
+        e = self.rt.entities.get(eid)
+        if mig is None or e is None or space_game == 0:
+            self._migrating.pop(eid, None)
+            return
+        conn = self.cluster.by_entity(eid)
+        if conn:
+            conn.send_migrate_request(eid, space_id, space_game)
+
+    def _h_migrate_request_ack(self, pkt):
+        eid = pkt.read_entity_id()
+        space_id = pkt.read_entity_id()
+        space_game = pkt.read_u16()
+        mig = self._migrating.pop(eid, None)
+        e = self.rt.entities.get(eid)
+        conn = self.cluster.by_entity(eid)
+        if mig is None or e is None:
+            if conn:
+                conn.send_cancel_migrate(eid)
+            return
+        if conn is None:
+            # dispatcher link mid-reconnect: abort rather than destroy the
+            # entity with nowhere to send its state (block expires server-side)
+            self.log.warning("migrate of %s aborted: dispatcher unavailable", eid)
+            return
+        data = e.migrate_data()
+        data["target_space"] = space_id
+        data["pos"] = mig["pos"].to_tuple()
+        gwutils.run_panicless(e.on_migrate_out, logger=self.log)
+        e._destroy_impl(is_migrate=True)
+        conn.send_real_migrate(eid, space_game, data)
+
+    def _h_real_migrate(self, pkt):
+        eid = pkt.read_entity_id()
+        _target = pkt.read_u16()
+        data = pkt.read_data()
+        client = data.get("client")
+        e = self.rt.entities.restore(
+            data, client_factory=lambda cid, gid: GameClient(cid, gid)
+        )
+        space_id = data.get("target_space")
+        sp = self.rt.entities.spaces.get(space_id) if space_id else None
+        if sp is not None:
+            x, y, z = data["pos"]
+            sp.enter_entity(e, Vector3(x, y, z))
+
+    def _h_game_disconnected(self, pkt):
+        gid = pkt.read_u16()
+        self.log.info("peer game%d disconnected", gid)
+
+    def _h_gate_disconnected(self, pkt):
+        gate_id = pkt.read_u16()
+        # detach all clients of that gate (reference: EntityManager.go:141-148)
+        for e in list(self.rt.entities.entities.values()):
+            if e.client is not None and e.client.gate_id == gate_id:
+                e.client = None
+                gwutils.run_panicless(e.on_client_disconnected, logger=self.log)
+
+    def _h_freeze_ack(self, pkt):
+        self._freeze_acks += 1
+        if self._freeze_acks >= self._freeze_acks_wanted:
+            self._do_freeze()
+
+    _HANDLERS = {
+        MT.MT_NOTIFY_DEPLOYMENT_READY: _h_deployment_ready,
+        MT.MT_NOTIFY_CLIENT_CONNECTED: _h_client_connected,
+        MT.MT_NOTIFY_CLIENT_DISCONNECTED: _h_client_disconnected,
+        MT.MT_CALL_ENTITY_METHOD: _h_call_entity_method,
+        MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT: _h_call_entity_method_from_client,
+        MT.MT_CALL_NIL_SPACES: _h_call_nil_spaces,
+        MT.MT_SYNC_POSITION_YAW_FROM_CLIENT: _h_sync_from_client,
+        MT.MT_CREATE_ENTITY_ANYWHERE: _h_create_entity_anywhere,
+        MT.MT_LOAD_ENTITY_ANYWHERE: _h_load_entity_anywhere,
+        MT.MT_SRVDIS_UPDATE: _h_srvdis_update,
+        MT.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE: _h_query_space_gameid_ack,
+        MT.MT_MIGRATE_REQUEST: _h_migrate_request_ack,
+        MT.MT_REAL_MIGRATE: _h_real_migrate,
+        MT.MT_NOTIFY_GAME_DISCONNECTED: _h_game_disconnected,
+        MT.MT_NOTIFY_GATE_DISCONNECTED: _h_gate_disconnected,
+        MT.MT_START_FREEZE_GAME_ACK: _h_freeze_ack,
+    }
+
+    # -- outbound ----------------------------------------------------------
+    def _on_entity_registered(self, e: Entity):
+        if self._registering_suppressed:
+            return
+        conn = self.cluster.by_entity(e.id)
+        if conn:
+            conn.send_notify_create_entity(e.id)
+
+    def _on_entity_unregistered(self, e: Entity):
+        if self._registering_suppressed:
+            return
+        conn = self.cluster.by_entity(e.id)
+        if conn:
+            conn.send_notify_destroy_entity(e.id)
+
+    def _drain_client_outboxes(self):
+        for e in self.rt.entities.entities.values():
+            cli = e.client
+            if cli is None or not cli.outbox:
+                continue
+            conn = self.cluster.by_gate(cli.gate_id)
+            if conn is None:
+                cli.outbox.clear()
+                continue
+            for op in cli.outbox:
+                self._send_client_op(conn, cli, op)
+            cli.outbox.clear()
+
+    def _send_client_op(self, conn: GWConnection, cli: GameClient, op: tuple):
+        kind = op[0]
+        if kind == "create_entity":
+            _, type_name, eid, is_player, attrs, pos, yaw = op
+            conn.send_create_entity_on_client(
+                cli.gate_id, cli.client_id, type_name, eid, is_player, attrs, pos, yaw
+            )
+        elif kind == "destroy_entity":
+            _, type_name, eid = op
+            conn.send_destroy_entity_on_client(
+                cli.gate_id, cli.client_id, type_name, eid
+            )
+        elif kind == "attr_delta":
+            _, eid, path, aop, value = op
+            conn.send_notify_attr_change_on_client(
+                cli.gate_id, cli.client_id, eid, path, aop, value
+            )
+        elif kind == "call":
+            _, eid, method, args = op
+            conn.send_call_entity_method_on_client(
+                cli.gate_id, cli.client_id, eid, method, args
+            )
+
+    def _send_position_syncs(self):
+        records = self.rt.drain_sync()
+        if not records:
+            return
+        per_gate: dict[int, Packet] = {}
+        for client_id, gate_id, eid, x, y, z, yaw in records:
+            p = per_gate.get(gate_id)
+            if p is None:
+                p = GWConnection.make_sync_on_clients_packet(gate_id)
+                per_gate[gate_id] = p
+            GWConnection.append_sync_record(p, client_id, eid, x, y, z, yaw)
+        for gate_id, p in per_gate.items():
+            conn = self.cluster.by_gate(gate_id)
+            if conn:
+                conn.send(p)
+
+    # -- cluster-facing API for entities/user code -------------------------
+    def call_entity(self, eid: str, method: str, *args):
+        """Local fast path, else route via dispatcher (reference:
+        EntityManager.Call, :429-442 + OPTIMIZE_LOCAL_ENTITY_CALL)."""
+        e = self.rt.entities.get(eid)
+        if e is not None:
+            self.rt.post.post(lambda: e.call(method, *args))
+            return
+        conn = self.cluster.by_entity(eid)
+        if conn:
+            conn.send_call_entity_method(eid, method, args)
+
+    def create_entity_anywhere(self, type_name: str, attrs: dict | None = None) -> str:
+        eid = gen_id()
+        conn = self.cluster.by_entity(eid)
+        if conn:
+            conn.send_create_entity_anywhere(type_name, eid, attrs or {})
+        return eid
+
+    def load_entity_anywhere(self, type_name: str, eid: str):
+        conn = self.cluster.by_entity(eid)
+        if conn:
+            conn.send_load_entity_anywhere(type_name, eid)
+
+    def call_nil_spaces(self, method: str, *args):
+        if self.nil_space is not None:
+            self.nil_space.call(method, *args)
+        conn = self.cluster.conns[0]
+        if conn:
+            conn.send_call_nil_spaces(self.id, method, args)
+
+    def enter_space(self, e: Entity, space_id: str, pos: Vector3):
+        """EnterSpace: local fast path or cross-game migration (§3.4)."""
+        sp = self.rt.entities.spaces.get(space_id)
+        if sp is not None:
+            def do_enter():
+                if e.space is not None:
+                    e.space.leave_entity(e)
+                sp.enter_entity(e, pos)
+            self.rt.post.post(do_enter)
+            return
+        self._migrating[e.id] = {"space_id": space_id, "pos": pos}
+        # the space's directory entry lives on the dispatcher shard of the
+        # SPACE id, not the entity's
+        conn = self.cluster.by_entity(space_id)
+        if conn:
+            conn.send_query_space_gameid_for_migrate(space_id, e.id)
+
+    def call_filtered_clients(self, key: str, op: int, value: str,
+                              method: str, *args):
+        conn = self.cluster.conns[0]
+        if conn:
+            conn.send_call_filtered_clients(key, op, value, method, args)
+
+    def set_client_filter_prop(self, e: Entity, key: str, value: str):
+        cli = e.client
+        if cli is None:
+            return
+        conn = self.cluster.by_gate(cli.gate_id)
+        if conn:
+            conn.send_set_clientproxy_filter_prop(cli.gate_id, cli.client_id, key, value)
+
+    def declare_service(self, srvid: str, info: str, force: bool = False):
+        conn = self.cluster.by_srvid(srvid)
+        if conn:
+            conn.send_srvdis_register(srvid, info, force)
+            conn.flush()
+
+    # -- freeze / restore (§3.6) -------------------------------------------
+    def freeze(self):
+        """SIGHUP hot-reload path: block traffic at dispatchers, dump all
+        entity state, exit (reference: GameService.go:221-272)."""
+        conns = self.cluster.all()
+        self._freeze_acks_wanted = len(conns)
+        self._freeze_acks = 0
+        for c in conns:
+            c.send_start_freeze_game()
+            c.flush()
+
+    def _do_freeze(self):
+        import msgpack
+
+        self.rt.post.tick(self.rt.on_error)  # drain pending posts
+        spaces, entities = [], []
+        for e in self.rt.entities.entities.values():
+            gwutils.run_panicless(e.on_freeze, logger=self.log)
+            d = e.migrate_data()
+            if e.is_space:
+                d["kind"] = getattr(e, "kind", 0)
+                d["aoi_dist"] = getattr(e, "_aoi_default_dist", 0.0)
+                d["aoi_enabled"] = getattr(e, "aoi_enabled", False)
+                d["members"] = [
+                    (m.id, m.position.to_tuple())
+                    for m in getattr(e, "entities", ())
+                ]
+                spaces.append(d)
+            else:
+                entities.append(d)
+        blob = msgpack.packb(
+            {"game_id": self.id, "spaces": spaces, "entities": entities},
+            use_bin_type=True,
+        )
+        with open(self._frozen_file, "wb") as f:
+            f.write(blob)
+        self.log.info("frozen %d spaces + %d entities -> %s",
+                      len(spaces), len(entities), self._frozen_file)
+        self._stop.set()
+        self.cluster.stop()
+
+    def _restore_from_freeze(self):
+        """Reference: restore.go + RestoreFreezedEntities 3-pass
+        (EntityManager.go:591-652)."""
+        import msgpack
+
+        with open(self._frozen_file, "rb") as f:
+            dump = msgpack.unpackb(f.read(), raw=False)
+        os.unlink(self._frozen_file)
+        self._registering_suppressed = True  # re-register via SET_GAME_ID list
+        try:
+            id2space = {}
+            for d in dump["spaces"]:
+                sp = self.rt.entities.restore(d)
+                sp.kind = d.get("kind", 0)
+                if d.get("aoi_enabled") and not sp.aoi_enabled:
+                    sp.enable_aoi(d.get("aoi_dist", 0.0))
+                id2space[d["id"]] = sp
+                if d["type"] == "__nil_space__":
+                    self.nil_space = sp
+            if self.nil_space is None:
+                self.nil_space = self.rt.entities.create(
+                    "__nil_space__", eid=fixed_id(f"nilspace-game{self.id}")
+                )
+            member_pos = {}
+            for d in dump["spaces"]:
+                for mid, pos in d.get("members", ()):
+                    member_pos[mid] = (d["id"], pos)
+            for d in dump["entities"]:
+                e = self.rt.entities.restore(
+                    d, client_factory=lambda cid, gid: GameClient(cid, gid)
+                )
+                # quiet client reattach: no re-create on the client
+                if e.client is not None:
+                    e.client.outbox.clear()
+                e.quiet_interest_ticks = 1  # client already has its neighbors
+                where = member_pos.get(e.id)
+                if where is not None:
+                    sp = id2space.get(where[0])
+                    if sp is not None:
+                        x, y, z = where[1]
+                        sp.enter_entity(e, Vector3(x, y, z))
+                gwutils.run_panicless(e.on_restored, logger=self.log)
+            self.log.info("restored %d spaces + %d entities",
+                          len(dump["spaces"]), len(dump["entities"]))
+        finally:
+            self._registering_suppressed = False

@@ -1,0 +1,167 @@
+"""Cluster configuration: one ini file shared by every process.
+
+Reference model: engine/config/read_config.go -- sections ``[dispatcherN]``,
+``[gameN]``, ``[gateN]`` with ``*_common`` inheritance, a ``[deployment]``
+section declaring desired counts, strict unknown-section validation.
+
+Example (tests/ and examples/ ship real ones):
+
+    [deployment]
+    dispatchers = 1
+    games = 2
+    gates = 1
+
+    [dispatcher1]
+    host = 127.0.0.1
+    port = 16001
+
+    [game_common]
+    aoi_backend = tpu
+    position_sync_interval_ms = 100
+
+    [game1]
+    [game2]
+
+    [gate1]
+    host = 127.0.0.1
+    port = 17001
+"""
+
+from __future__ import annotations
+
+import configparser
+from dataclasses import dataclass, field
+
+
+@dataclass
+class DispatcherConfig:
+    host: str = "127.0.0.1"
+    port: int = 16001
+
+
+@dataclass
+class GameConfig:
+    aoi_backend: str = "cpu"
+    tick_interval_ms: int = 5
+    position_sync_interval_ms: int = 100
+    save_interval_s: int = 300
+    boot_entity: str = ""
+    log_file: str = ""
+    http_port: int = 0
+
+
+@dataclass
+class GateConfig:
+    host: str = "127.0.0.1"
+    port: int = 17001
+    websocket_port: int = 0
+    compression: str = "gwlz"
+    heartbeat_timeout_s: float = 30.0
+    position_sync_interval_ms: int = 100
+    log_file: str = ""
+    http_port: int = 0
+
+
+@dataclass
+class StorageConfig:
+    backend: str = "filesystem"
+    directory: str = "entity_storage"
+
+
+@dataclass
+class KVDBConfig:
+    backend: str = "filesystem"
+    directory: str = "kvdb"
+
+
+@dataclass
+class ClusterConfig:
+    dispatchers: dict[int, DispatcherConfig] = field(default_factory=dict)
+    games: dict[int, GameConfig] = field(default_factory=dict)
+    gates: dict[int, GateConfig] = field(default_factory=dict)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    kvdb: KVDBConfig = field(default_factory=KVDBConfig)
+
+    def dispatcher_addrs(self) -> list[tuple[str, int]]:
+        return [
+            (d.host, d.port)
+            for _, d in sorted(self.dispatchers.items())
+        ]
+
+
+_KNOWN_PREFIXES = ("dispatcher", "game", "gate")
+_KNOWN_SECTIONS = ("deployment", "storage", "kvdb", "game_common", "gate_common",
+                   "dispatcher_common", "debug")
+
+
+def _apply(dc, section):
+    for key, value in section.items():
+        if not hasattr(dc, key):
+            raise ValueError(f"unknown config key {key!r} in {type(dc).__name__}")
+        cur = getattr(dc, key)
+        if isinstance(cur, bool):
+            value = value.lower() in ("1", "true", "yes")
+        elif isinstance(cur, int):
+            value = int(value)
+        elif isinstance(cur, float):
+            value = float(value)
+        setattr(dc, key, value)
+
+
+def load(path: str) -> ClusterConfig:
+    cp = configparser.ConfigParser()
+    read = cp.read(path)
+    if not read:
+        raise FileNotFoundError(path)
+    return parse(cp)
+
+
+def loads(text: str) -> ClusterConfig:
+    cp = configparser.ConfigParser()
+    cp.read_string(text)
+    return parse(cp)
+
+
+def parse(cp: configparser.ConfigParser) -> ClusterConfig:
+    cfg = ClusterConfig()
+    dep = cp["deployment"] if cp.has_section("deployment") else {}
+    n_disp = int(dep.get("dispatchers", 1))
+    n_games = int(dep.get("games", 1))
+    n_gates = int(dep.get("gates", 1))
+
+    for name in cp.sections():
+        if name in _KNOWN_SECTIONS:
+            continue
+        if not any(
+            name.startswith(p) and name[len(p) :].isdigit()
+            for p in _KNOWN_PREFIXES
+        ):
+            raise ValueError(f"unknown config section [{name}]")
+
+    def build(prefix, n, cls, common_name):
+        out = {}
+        for i in range(1, n + 1):
+            dc = cls()
+            if cp.has_section(common_name):
+                _apply(dc, cp[common_name])
+            sect = f"{prefix}{i}"
+            if cp.has_section(sect):
+                _apply(dc, cp[sect])
+            out[i] = dc
+        return out
+
+    cfg.dispatchers = build("dispatcher", n_disp, DispatcherConfig, "dispatcher_common")
+    cfg.games = build("game", n_games, GameConfig, "game_common")
+    cfg.gates = build("gate", n_gates, GateConfig, "gate_common")
+    # default distinct ports when unspecified
+    for i, d in cfg.dispatchers.items():
+        if d.port == 16001 and i > 1:
+            d.port = 16000 + i
+    for i, g in cfg.gates.items():
+        if g.port == 17001 and i > 1:
+            g.port = 17000 + i
+    if cp.has_section("storage"):
+        _apply(cfg.storage, cp["storage"])
+    if cp.has_section("kvdb"):
+        _apply(cfg.kvdb, cp["kvdb"])
+    return cfg

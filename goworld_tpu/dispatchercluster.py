@@ -1,0 +1,126 @@
+"""Dispatcher-cluster client: every game/gate connects to every dispatcher.
+
+Reference: engine/dispatchercluster (+ dispatcherclient) -- star topology per
+dispatcher; traffic for one entity always rides the same dispatcher so its
+delivery order is preserved (sharding function below); infinite reconnect
+with 1 s backoff and re-registration (DispatcherConnMgr.go:66-147).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable
+
+from .netutil import PacketConnection, Packet, connect_tcp
+from .proto import GWConnection
+from .utils import gwlog
+
+
+def entity_shard(eid: str, n: int) -> int:
+    """Entity -> dispatcher index; all parties compute identically
+    (reference: hash.go:7-12)."""
+    return zlib.crc32(eid.encode("ascii")) % n
+
+
+def gate_shard(gate_id: int, n: int) -> int:
+    return gate_id % n
+
+
+def srvid_shard(srvid: str, n: int) -> int:
+    return zlib.crc32(srvid.encode("utf-8")) % n
+
+
+class DispatcherCluster:
+    """Maintains one GWConnection per dispatcher.
+
+    ``on_packet(disp_index, Packet)`` is called from recv threads -- the
+    owner must enqueue into its logic loop.  ``register(conn)`` is called
+    (from the connect thread) every time a connection (re)establishes, so the
+    owner re-sends its registration.
+    """
+
+    def __init__(
+        self,
+        addrs: list[tuple[str, int]],
+        on_packet: Callable[[int, Packet], None],
+        register: Callable[[GWConnection], None],
+        tag: str = "cluster",
+    ):
+        self.addrs = addrs
+        self.on_packet = on_packet
+        self.register = register
+        self.conns: list[GWConnection | None] = [None] * len(addrs)
+        self._stop = threading.Event()
+        self.log = gwlog.logger(tag)
+        self._threads = [
+            threading.Thread(target=self._maintain, args=(i,), daemon=True)
+            for i in range(len(addrs))
+        ]
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        for c in self.conns:
+            if c is not None:
+                c.close()
+
+    def wait_connected(self, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(c is not None for c in self.conns):
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- connection maintenance (reference: assureConnected loop) ---------
+    def _maintain(self, i: int):
+        while not self._stop.is_set():
+            try:
+                sock = connect_tcp(self.addrs[i], timeout=5.0)
+            except OSError:
+                time.sleep(1.0)
+                continue
+            conn = GWConnection(PacketConnection(sock))
+            self.register(conn)
+            conn.flush()
+            self.conns[i] = conn
+            try:
+                while True:
+                    pkt = conn.recv_packet()
+                    if pkt is None:
+                        break
+                    self.on_packet(i, pkt)
+            except (OSError, ValueError):
+                pass
+            self.conns[i] = None
+            conn.close()
+            if not self._stop.is_set():
+                self.log.warning("dispatcher %d lost; reconnecting", i)
+                time.sleep(1.0)
+
+    # -- selection ---------------------------------------------------------
+    def by_entity(self, eid: str) -> GWConnection | None:
+        return self.conns[entity_shard(eid, len(self.conns))]
+
+    def by_gate(self, gate_id: int) -> GWConnection | None:
+        return self.conns[gate_shard(gate_id, len(self.conns))]
+
+    def by_srvid(self, srvid: str) -> GWConnection | None:
+        return self.conns[srvid_shard(srvid, len(self.conns))]
+
+    def all(self) -> list[GWConnection]:
+        return [c for c in self.conns if c is not None]
+
+    def flush_all(self):
+        for c in self.conns:
+            if c is not None:
+                try:
+                    c.flush()
+                except OSError:
+                    pass

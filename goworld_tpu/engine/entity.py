@@ -106,6 +106,11 @@ class Entity:
         self._sync_flags = 0
         self._attr_deltas: list[tuple] = []  # (path, op, value) this tick
         self.destroyed = False
+        # >0: suppress client create/destroy during AOI interest replay -- set
+        # for the first tick after freeze-restore, when the client already has
+        # the neighbor entities (reference: isRestore quiet re-enter,
+        # EntityManager.go:591-652)
+        self.quiet_interest_ticks = 0
 
     # ------------------------------------------------------------------ api
     @property
@@ -229,18 +234,19 @@ class Entity:
         # flush other's pending deltas to its *pre-existing* audience before
         # we join it: the snapshot below already contains them, and a mirror
         # that applied both would double-apply non-idempotent ops (APPEND/POP)
-        if self.client is not None:
+        quiet = self.quiet_interest_ticks > 0
+        if self.client is not None and not quiet:
             other._flush_attr_deltas()
         self.interested_in.add(other)
         other.interested_by.add(self)
-        if self.client is not None:
+        if self.client is not None and not quiet:
             self.client.create_entity(other, is_player=False)
         self.on_enter_aoi(other)
 
     def _uninterest(self, other: "Entity"):
         self.interested_in.discard(other)
         other.interested_by.discard(self)
-        if self.client is not None:
+        if self.client is not None and self.quiet_interest_ticks == 0:
             self.client.destroy_entity(other)
         self.on_leave_aoi(other)
 
@@ -276,6 +282,23 @@ class Entity:
             return
         self.set_client(None)
         other.set_client(client)
+
+    # -- space movement ----------------------------------------------------
+    def enter_space(self, space_id: str, pos: Vector3 | None = None):
+        """Move to another space -- same-game fast path or cross-game
+        migration when clustered (reference: EnterSpace, Entity.go:956-973)."""
+        pos = pos or Vector3()
+        rt = self._runtime()
+        game = getattr(rt, "game", None)
+        if game is not None:
+            game.enter_space(self, space_id, pos)
+            return
+        sp = self.manager.spaces.get(space_id)
+        if sp is None:
+            raise KeyError(f"no local space {space_id} (not clustered)")
+        if self.space is not None:
+            self.space.leave_entity(self)
+        sp.enter_entity(self, pos)
 
     # -- client calls ------------------------------------------------------
     def call_client(self, method: str, *args):

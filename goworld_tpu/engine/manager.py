@@ -89,6 +89,9 @@ class EntityManager:
         self.entities[e.id] = e
         if desc.is_space:
             self.spaces[e.id] = e  # type: ignore[assignment]
+        cb = getattr(self.runtime, "on_entity_registered", None)
+        if cb is not None:
+            cb(e)
         e.on_created()
         if space is not None:
             space.enter_entity(e, pos or Vector3())
@@ -133,3 +136,6 @@ class EntityManager:
     def _on_entity_destroyed(self, e: Entity):
         self.entities.pop(e.id, None)
         self.spaces.pop(e.id, None)
+        cb = getattr(self.runtime, "on_entity_unregistered", None)
+        if cb is not None:
+            cb(e)

@@ -46,6 +46,14 @@ class Runtime:
         # position sync records collected this tick:
         # (client_id, gate_id, entity_id, x, y, z, yaw)
         self.sync_out: list[tuple] = []
+        # optional hooks set by the hosting component (GameService): called
+        # when entities register/unregister so the dispatcher directory stays
+        # current (reference: MT_NOTIFY_CREATE_ENTITY/DESTROY)
+        self.on_entity_registered = None
+        self.on_entity_unregistered = None
+        # set by GameService when clustered; entities reach cluster ops
+        # (enter_space migration, remote calls) through it
+        self.game = None
 
     def _default_on_error(self, e: BaseException):
         import traceback
@@ -76,6 +84,8 @@ class Runtime:
                 e._sync_flags = 0
             if e._attr_deltas:
                 e._flush_attr_deltas()
+            if e.quiet_interest_ticks:
+                e.quiet_interest_ticks -= 1
 
     def _collect_sync(self, e: Entity):
         """One 16-byte-payload record per flagged entity per tick

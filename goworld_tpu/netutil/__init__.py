@@ -1,0 +1,12 @@
+"""Wire layer: packets, framing, connections, compression, packers."""
+
+from .compress import Compressor, new_compressor  # noqa: F401
+from .conn import (  # noqa: F401
+    COMPRESS_THRESHOLD,
+    FrameParser,
+    PacketConnection,
+    connect_tcp,
+    serve_tcp,
+)
+from .msgpacker import JSONMsgPacker, MessagePackMsgPacker, default_packer  # noqa: F401
+from .packet import MAX_PACKET_SIZE, Packet  # noqa: F401

@@ -1,0 +1,164 @@
+"""Packet compressors (reference role: engine/netutil/compress/compress.go
+with formats snappy/gwsnappy/lz4/lzw/flate; gwsnappy is the reference's only
+native code -- our native equivalent is the C++ ``gwlz`` codec).
+
+Available codecs:
+  * ``gwlz``  -- native C++ LZ77 (native/gwlz.cpp via ctypes); the default
+                 when built.  ``make -C native`` builds it; auto-built on
+                 first use if g++ is available.
+  * ``flate`` -- stdlib zlib (always available; the fallback).
+  * ``none``  -- identity.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import zlib
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libgwlz.so"))
+
+_build_lock = threading.Lock()
+_gwlz = None
+_gwlz_tried = False
+
+
+class Compressor:
+    name = "base"
+
+    def compress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+
+class NoCompressor(Compressor):
+    name = "none"
+
+    def compress(self, data: bytes) -> bytes:
+        return data
+
+    def decompress(self, data: bytes) -> bytes:
+        return data
+
+
+class FlateCompressor(Compressor):
+    name = "flate"
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, 1)
+
+    def decompress(self, data: bytes) -> bytes:
+        return zlib.decompress(data)
+
+
+def _load_gwlz():
+    """Load (building if needed) the native codec; None if unavailable."""
+    global _gwlz, _gwlz_tried
+    if _gwlz is not None or _gwlz_tried:
+        return _gwlz
+    with _build_lock:
+        if _gwlz is not None or _gwlz_tried:
+            return _gwlz
+        _gwlz_tried = True
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "-s"],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        lib.gwlz_max_compressed.restype = ctypes.c_size_t
+        lib.gwlz_max_compressed.argtypes = [ctypes.c_size_t]
+        lib.gwlz_compress.restype = ctypes.c_size_t
+        lib.gwlz_compress.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.gwlz_uncompressed_length.restype = ctypes.c_int64
+        lib.gwlz_uncompressed_length.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        lib.gwlz_decompress.restype = ctypes.c_int64
+        lib.gwlz_decompress.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        _gwlz = lib
+        return _gwlz
+
+
+class GwlzCompressor(Compressor):
+    """Native C++ codec; raises RuntimeError at construction if unavailable."""
+
+    name = "gwlz"
+
+    def __init__(self):
+        self._lib = _load_gwlz()
+        if self._lib is None:
+            raise RuntimeError("libgwlz.so unavailable (g++ build failed?)")
+
+    def compress(self, data: bytes) -> bytes:
+        lib = self._lib
+        cap = lib.gwlz_max_compressed(len(data))
+        out = ctypes.create_string_buffer(cap)
+        n = lib.gwlz_compress(data, len(data), out, cap)
+        if n == 0 and len(data) > 0:
+            raise RuntimeError("gwlz_compress failed")
+        return out.raw[:n]
+
+    def decompress(self, data: bytes) -> bytes:
+        lib = self._lib
+        size = lib.gwlz_uncompressed_length(data, len(data))
+        if size < 0:
+            raise ValueError("corrupt gwlz stream")
+        out = ctypes.create_string_buffer(max(1, size))
+        n = lib.gwlz_decompress(data, len(data), out, size)
+        if n != size:
+            raise ValueError("corrupt gwlz stream")
+        return out.raw[:size]
+
+
+_REGISTRY = {
+    "none": NoCompressor,
+    "flate": FlateCompressor,
+    "gwlz": GwlzCompressor,
+}
+
+
+def new_compressor(fmt: str) -> Compressor:
+    """Reference: compress.NewCompressor (compress.go:19-35).  ``gwlz`` falls
+    back to ``flate`` when the native library can't be built."""
+    if fmt in ("", "none"):
+        return NoCompressor()
+    if fmt == "gwlz":
+        try:
+            return GwlzCompressor()
+        except RuntimeError:
+            # LOUD fallback: peers must all pick the same codec -- a silent
+            # mismatch would surface as corrupt frames on the other side
+            import logging
+
+            logging.getLogger("gw.netutil").warning(
+                "libgwlz.so unavailable; falling back to flate -- every "
+                "cluster member must agree (set compression=flate in config "
+                "if any host lacks a C++ toolchain)"
+            )
+            return FlateCompressor()
+    cls = _REGISTRY.get(fmt)
+    if cls is None:
+        raise ValueError(f"unknown compression format {fmt!r}")
+    return cls()

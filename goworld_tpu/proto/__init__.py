@@ -1,0 +1,4 @@
+"""Wire protocol: message-type space and typed connection wrapper."""
+
+from .msgtypes import *  # noqa: F401,F403
+from .connection import GWConnection  # noqa: F401

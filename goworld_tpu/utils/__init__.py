@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: logging, op monitoring, crash isolation, cron."""

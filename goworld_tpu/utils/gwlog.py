@@ -1,0 +1,43 @@
+"""Leveled logging with per-component source tags (reference role:
+engine/gwlog -- zap-based; here stdlib logging with the same usage shape:
+``gwlog.logger("game1").info(...)``, level from config/CLI, optional file
+output, and a parseable readiness tag for the CLI's start barrier)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+# the CLI start barrier greps for this tag (reference: consts.go:133-137
+# supervisor tags watched by cmd start)
+READY_TAG = "COMPONENT_READY"
+
+_configured = False
+
+
+def setup(level: str = "info", logfile: str | None = None):
+    global _configured
+    root = logging.getLogger("gw")
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    root.handlers.clear()
+    handler = (
+        logging.FileHandler(logfile) if logfile else logging.StreamHandler(sys.stderr)
+    )
+    handler.setFormatter(
+        logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"
+        )
+    )
+    root.addHandler(handler)
+    _configured = True
+
+
+def logger(tag: str) -> logging.Logger:
+    if not _configured:
+        setup()
+    return logging.getLogger(f"gw.{tag}")
+
+
+def announce_ready(tag: str, component: str):
+    """Emit the supervisor-parseable readiness line."""
+    logger(tag).info("%s %s", READY_TAG, component)

@@ -1,0 +1,66 @@
+"""In-process operation monitor (reference: engine/opmon -- count/avg/max per
+named operation, slow-op warnings, periodic dump)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _OpStat:
+    count: int = 0
+    total: float = 0.0
+    peak: float = 0.0
+
+
+_lock = threading.Lock()
+_stats: dict[str, _OpStat] = {}
+
+
+class Operation:
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = time.perf_counter()
+
+    def finish(self, warn_threshold: float = 0.0, logger=None):
+        dt = time.perf_counter() - self.t0
+        with _lock:
+            st = _stats.setdefault(self.name, _OpStat())
+            st.count += 1
+            st.total += dt
+            st.peak = max(st.peak, dt)
+        if warn_threshold and dt > warn_threshold and logger is not None:
+            logger.warning("op %s took %.1f ms (> %.1f ms)",
+                           self.name, dt * 1e3, warn_threshold * 1e3)
+        return dt
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+
+
+def start_operation(name: str) -> Operation:
+    return Operation(name)
+
+
+def dump() -> dict[str, dict]:
+    with _lock:
+        return {
+            name: {
+                "count": st.count,
+                "avg_ms": (st.total / st.count * 1e3) if st.count else 0.0,
+                "max_ms": st.peak * 1e3,
+            }
+            for name, st in _stats.items()
+        }
+
+
+def reset():
+    with _lock:
+        _stats.clear()

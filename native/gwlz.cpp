@@ -1,0 +1,212 @@
+// gwlz: the framework's native packet codec.
+//
+// Role equivalent (not a port) of the reference's vendored native compressor
+// (gwsnappy: snappy-go with hand-written amd64 assembly,
+// /root/reference/engine/lib/gwsnappy) -- a byte-oriented LZ77 codec tuned
+// for small game packets: greedy hash-chain matcher, 64 KiB window,
+// varint-framed, self-describing length.  Both ends of every connection are
+// this framework, so the format is our own (documented below), chosen for
+// encode speed over ratio.
+//
+// Format:
+//   header : uvarint uncompressed_length
+//   stream : sequence of tokens
+//     literal token : tag byte (len-1) << 2 | 0x0, for len in 1..60;
+//                     tags 60..63 with 1..4 extra length bytes (LE)
+//                     followed by `len` literal bytes
+//     copy token    : tag byte 0x1 | (len-4) << 2 (len 4..63+),
+//                     len >= 64 encoded as tag 0x3 + uvarint(len),
+//                     then u16 LE offset (1..65535 back)
+//
+// Exposed C ABI (ctypes):
+//   size_t gwlz_max_compressed(size_t n);
+//   size_t gwlz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap);
+//   int64_t gwlz_uncompressed_length(const uint8_t* src, size_t n);
+//   int64_t gwlz_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap);
+//
+// Build: make -C native  (produces libgwlz.so, loaded via ctypes by
+// goworld_tpu/netutil/compress.py; zlib fallback if absent).
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace {
+
+constexpr size_t kWindow = 65535;
+constexpr size_t kHashBits = 14;
+constexpr size_t kHashSize = 1u << kHashBits;
+constexpr size_t kMinMatch = 4;
+
+inline uint32_t load32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;
+}
+
+inline uint32_t hash32(uint32_t v) {
+    return (v * 0x9E3779B1u) >> (32 - kHashBits);
+}
+
+inline uint8_t* put_uvarint(uint8_t* p, uint64_t v) {
+    while (v >= 0x80) {
+        *p++ = static_cast<uint8_t>(v) | 0x80;
+        v >>= 7;
+    }
+    *p++ = static_cast<uint8_t>(v);
+    return p;
+}
+
+inline const uint8_t* get_uvarint(const uint8_t* p, const uint8_t* end,
+                                  uint64_t* out) {
+    uint64_t v = 0;
+    int shift = 0;
+    while (p < end && shift < 64) {
+        uint8_t b = *p++;
+        v |= static_cast<uint64_t>(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = v;
+            return p;
+        }
+        shift += 7;
+    }
+    return nullptr;
+}
+
+// emit a literal run [lit, lit+n)
+inline uint8_t* emit_literal(uint8_t* dst, const uint8_t* lit, size_t n) {
+    while (n > 0) {
+        size_t chunk = n;
+        if (chunk <= 60) {
+            *dst++ = static_cast<uint8_t>((chunk - 1) << 2);
+        } else {
+            size_t c = chunk;
+            int extra = c <= 0xFF ? 1 : c <= 0xFFFF ? 2 : c <= 0xFFFFFF ? 3 : 4;
+            if (extra == 4 && c > 0xFFFFFFFFull) c = chunk = 0xFFFFFFFFull;
+            *dst++ = static_cast<uint8_t>((59 + extra) << 2);
+            for (int i = 0; i < extra; i++) dst[i] = static_cast<uint8_t>(c >> (8 * i));
+            dst += extra;
+        }
+        std::memcpy(dst, lit, chunk);
+        dst += chunk;
+        lit += chunk;
+        n -= chunk;
+    }
+    return dst;
+}
+
+inline uint8_t* emit_copy(uint8_t* dst, size_t offset, size_t len) {
+    if (len < 64) {
+        *dst++ = static_cast<uint8_t>(0x1 | ((len - kMinMatch) << 2));
+    } else {
+        *dst++ = 0x3;
+        dst = put_uvarint(dst, len);
+    }
+    *dst++ = static_cast<uint8_t>(offset);
+    *dst++ = static_cast<uint8_t>(offset >> 8);
+    return dst;
+}
+
+}  // namespace
+
+extern "C" {
+
+size_t gwlz_max_compressed(size_t n) {
+    // worst case: all literals, one tag + 4 len bytes per 2^32 chunk, plus header
+    return n + n / 60 + 16;
+}
+
+size_t gwlz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+    if (cap < gwlz_max_compressed(n)) return 0;
+    uint8_t* out = put_uvarint(dst, n);
+    if (n < kMinMatch + 4) {
+        if (n) out = emit_literal(out, src, n);
+        return static_cast<size_t>(out - dst);
+    }
+    uint32_t table[kHashSize];
+    std::memset(table, 0xFF, sizeof(table));  // 0xFFFFFFFF = empty
+    size_t i = 0;
+    size_t lit_start = 0;
+    const size_t limit = n - kMinMatch;  // last position where a match can start
+    while (i <= limit) {
+        uint32_t h = hash32(load32(src + i));
+        uint32_t cand = table[h];
+        table[h] = static_cast<uint32_t>(i);
+        if (cand != 0xFFFFFFFFu && i - cand <= kWindow &&
+            load32(src + cand) == load32(src + i)) {
+            // extend match
+            size_t len = kMinMatch;
+            size_t max_len = n - i;
+            while (len < max_len && src[cand + len] == src[i + len]) len++;
+            if (i > lit_start) out = emit_literal(out, src + lit_start, i - lit_start);
+            out = emit_copy(out, i - cand, len);
+            // insert a few positions inside the match to help future matches
+            size_t end = i + len;
+            for (size_t j = i + 1; j + kMinMatch <= end && j <= limit && j < i + 4; j++)
+                table[hash32(load32(src + j))] = static_cast<uint32_t>(j);
+            i = end;
+            lit_start = i;
+        } else {
+            i++;
+        }
+    }
+    if (lit_start < n) out = emit_literal(out, src + lit_start, n - lit_start);
+    return static_cast<size_t>(out - dst);
+}
+
+int64_t gwlz_uncompressed_length(const uint8_t* src, size_t n) {
+    uint64_t len;
+    const uint8_t* p = get_uvarint(src, src + n, &len);
+    if (!p) return -1;
+    return static_cast<int64_t>(len);
+}
+
+int64_t gwlz_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+    const uint8_t* end = src + n;
+    uint64_t expect;
+    const uint8_t* p = get_uvarint(src, end, &expect);
+    if (!p || expect > cap) return -1;
+    uint8_t* out = dst;
+    uint8_t* out_end = dst + expect;
+    while (p < end && out < out_end) {
+        uint8_t tag = *p++;
+        if ((tag & 0x3) == 0x0) {  // literal
+            size_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                int extra = static_cast<int>(len - 60);
+                if (p + extra > end) return -1;
+                len = 0;
+                for (int k = 0; k < extra; k++) len |= static_cast<size_t>(p[k]) << (8 * k);
+                p += extra;
+            }
+            if (p + len > end || out + len > out_end) return -1;
+            std::memcpy(out, p, len);
+            p += len;
+            out += len;
+        } else {  // copy
+            size_t len;
+            if (tag == 0x3) {
+                uint64_t l;
+                p = get_uvarint(p, end, &l);
+                if (!p) return -1;
+                len = static_cast<size_t>(l);
+            } else {
+                len = (tag >> 2) + kMinMatch;
+            }
+            if (p + 2 > end) return -1;
+            size_t offset = p[0] | (static_cast<size_t>(p[1]) << 8);
+            p += 2;
+            if (offset == 0 || static_cast<size_t>(out - dst) < offset ||
+                out + len > out_end)
+                return -1;
+            // overlapping copy must run byte-forward
+            const uint8_t* from = out - offset;
+            for (size_t k = 0; k < len; k++) out[k] = from[k];
+            out += len;
+        }
+    }
+    if (out != out_end) return -1;
+    return static_cast<int64_t>(expect);
+}
+
+}  // extern "C"

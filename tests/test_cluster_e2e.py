@@ -1,0 +1,188 @@
+"""End-to-end cluster test: real dispatcher + 2 games + gate over localhost
+TCP, driven by bot clients asserting the full protocol (reference test model:
+.travis.yml's test_client -strict run against a multi-process cluster;
+single-host multi-component here, in-process threads instead of processes)."""
+
+import time
+
+import pytest
+
+from goworld_tpu import config as gwconfig
+from goworld_tpu.client import GameClientConnection
+from goworld_tpu.components.dispatcher.service import DispatcherService
+from goworld_tpu.components.game.service import GameService
+from goworld_tpu.components.gate.service import GateService
+from goworld_tpu.engine.entity import Entity
+from goworld_tpu.engine.rpc import OWN_CLIENT, rpc
+from goworld_tpu.engine.space import Space
+from goworld_tpu.engine.vector import Vector3
+
+CONFIG = """
+[deployment]
+dispatchers = 1
+games = 2
+gates = 1
+
+[dispatcher1]
+port = 0
+
+[game_common]
+boot_entity = TestAvatar
+aoi_backend = cpu
+
+[gate1]
+port = 0
+heartbeat_timeout_s = 0
+"""
+
+
+class TestScene(Space):
+    __test__ = False
+
+
+class TestAvatar(Entity):
+    __test__ = False
+    use_aoi = True
+    aoi_distance = 100.0
+    all_client_attrs = frozenset({"name"})
+    client_attrs = frozenset({"secret"})
+
+    def on_created(self):
+        self.attrs.set("name", "anon")
+        self.set_client_syncing(True)
+
+    @rpc(expose=OWN_CLIENT)
+    def join_scene(self):
+        scene_id = self._runtime().game.srvmap.get("scene")
+        if scene_id:
+            self.enter_space(scene_id, Vector3(10.0, 0.0, 10.0))
+
+    @rpc(expose=OWN_CLIENT)
+    def set_name(self, name):
+        self.attrs.set("name", name)
+
+    @rpc(expose=OWN_CLIENT)
+    def shout(self, text):
+        self.call_all_clients("hear", text)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    cfg = gwconfig.loads(CONFIG)
+    disp = DispatcherService(1, cfg).start()
+    cfg.dispatchers[1].host, cfg.dispatchers[1].port = disp.addr
+    games = []
+    for gid in (1, 2):
+        gs = GameService(gid, cfg, freeze_dir=str(tmp_path))
+        gs.register_entity_type(TestScene)
+        gs.register_entity_type(TestAvatar)
+        gs.start()
+        games.append(gs)
+    gate = GateService(1, cfg).start()
+    # wait for deployment readiness
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not all(g.deployment_ready for g in games):
+        time.sleep(0.01)
+    assert all(g.deployment_ready for g in games), "deployment never became ready"
+    # game1 creates the shared scene and declares it via srvdis
+    g1 = games[0]
+
+    def make_scene():
+        sp = g1.rt.entities.create_space("TestScene", kind=1)
+        sp.enable_aoi(100.0)
+        g1.declare_service("scene", sp.id)
+
+    g1.rt.post.post(make_scene)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not all(
+        "scene" in g.srvmap for g in games
+    ):
+        time.sleep(0.01)
+    assert all("scene" in g.srvmap for g in games), "srvdis never propagated"
+    yield disp, games, gate
+    gate.stop()
+    for g in games:
+        g.stop()
+    disp.stop()
+
+
+def connect_client(gate) -> GameClientConnection:
+    c = GameClientConnection(gate.addr)
+    assert c.wait_for(lambda c: c.player is not None, 10.0), "no boot entity"
+    return c
+
+
+def test_full_cluster_flow(cluster):
+    disp, games, gate = cluster
+    c1 = connect_client(gate)
+    c2 = connect_client(gate)
+    # boot entities round-robin over both games
+    assert c1.player is not None and c2.player is not None
+    assert c1.client_id != c2.client_id
+
+    # both avatars join the shared scene (one of them migrates cross-game)
+    c1.call_player("join_scene")
+    c2.call_player("join_scene")
+    assert c1.wait_for(
+        lambda c: len(c.entities) >= 2, 10.0
+    ), f"c1 never saw the other avatar: {c1.entities}"
+    assert c2.wait_for(lambda c: len(c.entities) >= 2, 10.0)
+    other_for_c1 = next(
+        e for e in c1.entities.values() if e.id != c1.player.id
+    )
+    assert other_for_c1.id == c2.player.id
+
+    # attr replication: c2 renames; c1's mirror of c2 updates
+    c2.call_player("set_name", "bob")
+    assert c1.wait_for(
+        lambda c: c.entities.get(c2.player.id) is not None
+        and c.entities[c2.player.id].attrs.get("name") == "bob",
+        10.0,
+    ), "attr delta never reached neighbor client"
+    # 'secret' (client-class) must NOT appear in the neighbor's mirror
+    assert "secret" not in other_for_c1.attrs.keys()
+
+    # client-driven movement syncs to the neighbor
+    c2.send_position(55.0, 0.0, 55.0)
+    assert c1.wait_for(
+        lambda c: c.entities[c2.player.id].position[0] == 55.0, 10.0
+    ), "position sync never reached neighbor"
+
+    # call_all_clients reaches both
+    c2.call_player("shout", "hello")
+    assert c2.wait_for(
+        lambda c: ("hear", ("hello",)) in c.player.calls, 10.0
+    )
+    assert c1.wait_for(
+        lambda c: any(
+            ("hear", ("hello",)) in e.calls for e in c.entities.values()
+        ),
+        10.0,
+    ), "call_all_clients never reached the neighbor"
+
+    # walking out of AOI range destroys the mirror on the neighbor
+    c2.send_position(3000.0, 0.0, 3000.0)
+    assert c1.wait_for(
+        lambda c: c2.player.id not in c.entities, 10.0
+    ), "leave-AOI destroy never reached neighbor"
+
+    c1.close()
+    c2.close()
+
+
+def test_client_disconnect_notifies_owner(cluster):
+    disp, games, gate = cluster
+    c1 = connect_client(cluster[2])
+    eid = c1.player.id
+    c1.close()
+    deadline = time.monotonic() + 5
+    gone = False
+    while time.monotonic() < deadline:
+        gone = all(
+            g.rt.entities.get(eid) is None or g.rt.entities.get(eid).client is None
+            for g in games
+        )
+        if gone:
+            break
+        time.sleep(0.05)
+    assert gone, "owner entity kept its client after disconnect"
