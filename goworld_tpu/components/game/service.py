@@ -68,7 +68,31 @@ class GameService:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._registering_suppressed = False
+        self.storage = None  # EntityStorageService, via attach_storage
+        self.kvdb = None  # KVDBService, via attach_kvdb
         self.rt.entities.register(NilSpace, "__nil_space__")
+
+    def attach_storage(self, base_dir: str = "."):
+        """Create the async entity-storage service from config (reference:
+        storage.Initialize, game.go:100)."""
+        from ...storage import EntityStorageService, new_entity_storage
+
+        backend = new_entity_storage(
+            self.cfg.storage.backend,
+            directory=os.path.join(base_dir, self.cfg.storage.directory),
+        )
+        self.storage = EntityStorageService(backend, post=self.rt.post.post)
+        return self.storage
+
+    def attach_kvdb(self, base_dir: str = "."):
+        from ...kvdb import KVDBService, new_kvdb_backend
+
+        backend = new_kvdb_backend(
+            self.cfg.kvdb.backend,
+            directory=os.path.join(base_dir, self.cfg.kvdb.directory),
+        )
+        self.kvdb = KVDBService(backend, post=self.rt.post.post)
+        return self.kvdb
 
     # -- boot --------------------------------------------------------------
     def register_entity_type(self, cls, name=None):
@@ -133,6 +157,9 @@ class GameService:
 
     def step(self, n: int = 1):
         """Synchronous tick driver for tests (no background thread)."""
+        assert self._thread is None or not self._thread.is_alive(), (
+            "step() must not race the started logic thread"
+        )
         for _ in range(n):
             while True:
                 try:
@@ -338,6 +365,8 @@ class GameService:
 
     # -- outbound ----------------------------------------------------------
     def _on_entity_registered(self, e: Entity):
+        if e.persistent and self.gcfg.save_interval_s > 0:
+            e.add_timer(float(self.gcfg.save_interval_s), "save")
         if self._registering_suppressed:
             return
         conn = self.cluster.by_entity(e.id)

@@ -182,6 +182,16 @@ class Entity:
             if k in self.persistent_attrs
         }
 
+    def save(self):
+        """Queue an async save of the persistent attr subset (reference:
+        Entity.Save; periodic timer per save_interval, Entity.go:215-222)."""
+        if not self.persistent or self.destroyed:
+            return
+        game = getattr(self._runtime(), "game", None)
+        storage = getattr(game, "storage", None) if game is not None else None
+        if storage is not None:
+            storage.save(self.type_name, self.id, self.persistent_data())
+
     def _flush_attr_deltas(self):
         """Route this tick's attr deltas to own client / neighbor clients."""
         if not self._attr_deltas:
@@ -443,6 +453,10 @@ class Entity:
         if self.space is not None:
             self.space.leave_entity(self)
         if not is_migrate:
+            if self.persistent:
+                self.destroyed = False  # save() guards on destroyed
+                self.save()
+                self.destroyed = True
             self.on_destroy()
             if self.client is not None:
                 self.client.destroy_entity(self)

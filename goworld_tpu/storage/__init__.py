@@ -1,0 +1,10 @@
+"""Async entity persistence.
+
+Reference: engine/storage (storage.go -- one background worker drains an op
+queue; save failures retry forever; completion callbacks re-enter the logic
+thread via post).  Backend interface mirrors
+storage_common.EntityStorage{List,Write,Read,Exists,Close}.
+"""
+
+from .service import EntityStorageService  # noqa: F401
+from .backends import FilesystemEntityStorage, new_entity_storage  # noqa: F401
