@@ -1,0 +1,123 @@
+"""Headless load-test bot client (reference: examples/test_client -- N bots
+speaking the full client protocol with strict assertions and a per-op
+latency profiler).
+
+    python examples/test_client.py --gate 127.0.0.1:17001 -N 50 \
+        --duration 30 --strict
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from goworld_tpu.client import GameClientConnection
+
+
+class Bot(threading.Thread):
+    def __init__(self, addr, idx, duration, strict, stats):
+        super().__init__(daemon=True)
+        self.addr = addr
+        self.idx = idx
+        self.duration = duration
+        self.strict = strict
+        self.stats = stats
+        self.ok = False
+        self.error = ""
+
+    def run(self):
+        try:
+            self._run()
+            self.ok = True
+        except Exception as e:
+            self.error = f"{type(e).__name__}: {e}"
+            if self.strict:
+                raise
+
+    def _assert(self, cond, msg):
+        if self.strict:
+            assert cond, f"bot{self.idx}: {msg}"
+
+    def _run(self):
+        rng = random.Random(self.idx)
+        t0 = time.perf_counter()
+        c = GameClientConnection(self.addr)
+        self._assert(
+            c.wait_for(lambda c: c.player is not None, 15), "no boot entity"
+        )
+        self.stats.record("login", time.perf_counter() - t0)
+        c.call_player("enter_game", f"bot{self.idx}")
+        self._assert(
+            c.wait_for(lambda c: c.player and c.player.attrs.get("name") == f"bot{self.idx}", 15),
+            "enter_game attr never mirrored",
+        )
+        x, z = rng.uniform(0, 200), rng.uniform(0, 200)
+        deadline = time.time() + self.duration
+        last_hb = 0.0
+        while time.time() < deadline:
+            x += rng.uniform(-5, 5)
+            z += rng.uniform(-5, 5)
+            t = time.perf_counter()
+            c.send_position(x, 0.0, z)
+            c.poll(0.05)
+            self.stats.record("tick", time.perf_counter() - t)
+            if time.time() - last_hb > 5:
+                c.heartbeat()
+                last_hb = time.time()
+            if self.strict and c.player is not None:
+                for e in c.entities.values():
+                    assert e.id, "mirror with empty id"
+        c.close()
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.samples: dict[str, list[float]] = {}
+
+    def record(self, op, dt):
+        with self.lock:
+            self.samples.setdefault(op, []).append(dt)
+
+    def dump(self):
+        for op, xs in sorted(self.samples.items()):
+            ms = [x * 1e3 for x in xs]
+            print(
+                f"{op:8s} n={len(ms):<7d} avg={statistics.mean(ms):8.2f}ms "
+                f"p95={statistics.quantiles(ms, n=20)[-1] if len(ms) > 20 else max(ms):8.2f}ms "
+                f"max={max(ms):8.2f}ms"
+            )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--gate", default="127.0.0.1:17001")
+    ap.add_argument("-N", type=int, default=10)
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--strict", action="store_true")
+    args = ap.parse_args()
+    host, port = args.gate.rsplit(":", 1)
+    addr = (host, int(port))
+    stats = Stats()
+    bots = [Bot(addr, i, args.duration, args.strict, stats) for i in range(args.N)]
+    for b in bots:
+        b.start()
+        time.sleep(0.01)
+    for b in bots:
+        b.join(args.duration + 60)
+    failed = [b for b in bots if not b.ok]
+    stats.dump()
+    print(f"{len(bots) - len(failed)}/{len(bots)} bots OK")
+    for b in failed[:5]:
+        print(f"  bot{b.idx} failed: {b.error}", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""Operator CLI (reference: cmd/goworld -- build|start|stop|kill|reload|status).
+
+    python -m goworld_tpu.cli start  -c goworld.ini -s mygame.py -d rundir
+    python -m goworld_tpu.cli status -d rundir
+    python -m goworld_tpu.cli reload -c goworld.ini -s mygame.py -d rundir
+    python -m goworld_tpu.cli stop   -d rundir
+
+``start`` launches dispatchers -> games -> gates as real processes, waiting
+for each component's readiness tag in its log before starting the next kind
+(reference start barrier: start.go:98-116 watching supervisor tags).
+``reload`` SIGHUPs the games (freeze), waits for them to exit, and restarts
+them with -restore -- clients stay connected through the gates.
+``stop`` signals gates -> games -> dispatchers (reference order, stop.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from . import config as gwconfig
+from .utils.gwlog import READY_TAG
+
+
+def _pidfile(rundir: str, name: str) -> str:
+    return os.path.join(rundir, f"{name}.pid")
+
+
+def _logfile(rundir: str, name: str) -> str:
+    return os.path.join(rundir, f"{name}.log")
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def _read_pids(rundir: str) -> dict[str, int]:
+    out = {}
+    if not os.path.isdir(rundir):
+        return out
+    for fn in sorted(os.listdir(rundir)):
+        if fn.endswith(".pid"):
+            try:
+                out[fn[:-4]] = int(open(os.path.join(rundir, fn)).read())
+            except (ValueError, OSError):
+                pass
+    return out
+
+
+def _spawn(rundir: str, name: str, argv: list[str]) -> int:
+    log = open(_logfile(rundir, name), "ab")
+    proc = subprocess.Popen(
+        argv, stdout=log, stderr=subprocess.STDOUT, cwd=rundir,
+        start_new_session=True,
+    )
+    with open(_pidfile(rundir, name), "w") as f:
+        f.write(str(proc.pid))
+    return proc.pid
+
+
+def _wait_ready(rundir: str, name: str, timeout: float = 30.0) -> bool:
+    """Watch the component's log for the readiness tag."""
+    path = _logfile(rundir, name)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with open(path, "rb") as f:
+                if READY_TAG.encode() in f.read():
+                    return True
+        except FileNotFoundError:
+            pass
+        time.sleep(0.05)
+    return False
+
+
+def _fail_and_teardown(rundir: str, what: str) -> int:
+    """A component never became ready: kill everything already spawned so a
+    retried start doesn't stack duplicate processes on the same ports."""
+    print(f"{what}; tearing down partial cluster", file=sys.stderr)
+    _signal_kind(rundir, "gate", signal.SIGTERM)
+    _signal_kind(rundir, "game", signal.SIGTERM)
+    _signal_kind(rundir, "dispatcher", signal.SIGTERM)
+    return 1
+
+
+def cmd_start(args) -> int:
+    cfg = gwconfig.load(args.config)
+    os.makedirs(args.dir, exist_ok=True)
+    config_abs = os.path.abspath(args.config)
+    script_abs = os.path.abspath(args.script) if args.script else None
+    if cfg.games and script_abs is None:
+        print("start: -s/--script is required when games > 0", file=sys.stderr)
+        return 1
+    if script_abs is not None and not os.path.exists(script_abs):
+        print(f"start: script not found: {script_abs}", file=sys.stderr)
+        return 1
+    py = sys.executable
+
+    for i in cfg.dispatchers:
+        name = f"dispatcher{i}"
+        _spawn(args.dir, name, [py, "-m", "goworld_tpu.components.dispatcher",
+                                "-dispid", str(i), "-configfile", config_abs])
+    for i in cfg.dispatchers:
+        if not _wait_ready(args.dir, f"dispatcher{i}"):
+            return _fail_and_teardown(args.dir, f"dispatcher{i} failed to become ready")
+    for i in cfg.games:
+        name = f"game{i}"
+        argv = [py, "-m", "goworld_tpu.components.game", "-gid", str(i),
+                "-configfile", config_abs, "-script", script_abs, "-dir", "."]
+        if args.restore:
+            argv.append("-restore")
+        _spawn(args.dir, name, argv)
+    for i in cfg.games:
+        if not _wait_ready(args.dir, f"game{i}"):
+            return _fail_and_teardown(args.dir, f"game{i} failed to become ready")
+    for i in cfg.gates:
+        name = f"gate{i}"
+        _spawn(args.dir, name, [py, "-m", "goworld_tpu.components.gate",
+                                "-gateid", str(i), "-configfile", config_abs])
+    for i in cfg.gates:
+        if not _wait_ready(args.dir, f"gate{i}"):
+            return _fail_and_teardown(args.dir, f"gate{i} failed to become ready")
+    print(f"cluster up: {len(cfg.dispatchers)} dispatcher(s), "
+          f"{len(cfg.games)} game(s), {len(cfg.gates)} gate(s)")
+    return 0
+
+
+def _signal_kind(rundir: str, prefix: str, sig, wait: float = 10.0) -> list[str]:
+    pids = _read_pids(rundir)
+    names = [n for n in pids if n.startswith(prefix)]
+    for n in names:
+        if _alive(pids[n]):
+            os.kill(pids[n], sig)
+    deadline = time.time() + wait
+    while time.time() < deadline and any(_alive(pids[n]) for n in names):
+        time.sleep(0.05)
+    for n in names:
+        if not _alive(pids[n]):
+            try:
+                os.unlink(_pidfile(rundir, n))
+            except OSError:
+                pass
+    return names
+
+
+def cmd_stop(args) -> int:
+    # reference order: gates -> games -> dispatchers (stop.go:11-78)
+    _signal_kind(args.dir, "gate", signal.SIGTERM)
+    _signal_kind(args.dir, "game", signal.SIGTERM)
+    _signal_kind(args.dir, "dispatcher", signal.SIGTERM)
+    print("cluster stopped")
+    return 0
+
+
+def cmd_kill(args) -> int:
+    for name, pid in _read_pids(args.dir).items():
+        if _alive(pid):
+            os.kill(pid, signal.SIGKILL)
+    print("cluster killed")
+    return 0
+
+
+def cmd_status(args) -> int:
+    pids = _read_pids(args.dir)
+    if not pids:
+        print("no components found")
+        return 1
+    rc = 0
+    for name, pid in sorted(pids.items()):
+        ok = _alive(pid)
+        print(f"{name:16s} pid={pid:<8d} {'RUNNING' if ok else 'DEAD'}")
+        rc |= 0 if ok else 1
+    return rc
+
+
+def cmd_reload(args) -> int:
+    """Freeze games via SIGHUP, then restart them with -restore (clients stay
+    connected through the gates) -- reference: reload.go:10-33."""
+    cfg = gwconfig.load(args.config)
+    pids = _read_pids(args.dir)
+    game_names = [f"game{i}" for i in cfg.games if f"game{i}" in pids]
+    for n in game_names:
+        if _alive(pids[n]):
+            os.kill(pids[n], signal.SIGHUP)
+    deadline = time.time() + 30
+    while time.time() < deadline and any(_alive(pids[n]) for n in game_names):
+        time.sleep(0.05)
+    still = [n for n in game_names if _alive(pids[n])]
+    if still:
+        print(f"games did not freeze: {still}", file=sys.stderr)
+        return 1
+    config_abs = os.path.abspath(args.config)
+    script_abs = os.path.abspath(args.script)
+    py = sys.executable
+    for i in cfg.games:
+        name = f"game{i}"
+        # truncate log so the ready-barrier watches the fresh run
+        open(_logfile(args.dir, name), "wb").close()
+        _spawn(args.dir, name,
+               [py, "-m", "goworld_tpu.components.game", "-gid", str(i),
+                "-configfile", config_abs, "-script", script_abs,
+                "-dir", ".", "-restore"])
+    for i in cfg.games:
+        if not _wait_ready(args.dir, f"game{i}"):
+            print(f"game{i} failed to restore", file=sys.stderr)
+            return 1
+    print("reload complete")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="goworld_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name, fn in [("start", cmd_start), ("stop", cmd_stop),
+                     ("kill", cmd_kill), ("status", cmd_status),
+                     ("reload", cmd_reload)]:
+        p = sub.add_parser(name)
+        p.add_argument("-d", "--dir", default="gwrun")
+        if name in ("start", "reload"):
+            p.add_argument("-c", "--config", required=True)
+            p.add_argument("-s", "--script", default=None,
+                           required=(name == "reload"))
+            if name == "start":
+                p.add_argument("--restore", action="store_true")
+        p.set_defaults(fn=fn)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

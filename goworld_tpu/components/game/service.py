@@ -115,15 +115,26 @@ class GameService:
     def stop(self, save: bool = True):
         """Graceful terminate (reference: SIGTERM path, GameService.go:200-219):
         save persistent entities (when storage is attached), destroy all with
-        hooks, then drop the cluster links."""
-        storage = getattr(self, "storage", None)
-        for e in list(self.rt.entities.entities.values()):
-            if save and storage is not None and e.persistent:
-                storage.save(e.type_name, e.id, e.persistent_data())
-            gwutils.run_panicless(e.destroy, logger=self.log)
-        if storage is not None:
-            storage.wait_idle(5.0)
-        self._stop.set()
+        hooks, then drop the cluster links.  Entity teardown is marshaled onto
+        the logic thread -- destroying from another thread would race the
+        tick's entity iteration."""
+
+        def terminate():
+            for e in list(self.rt.entities.entities.values()):
+                if save and self.storage is not None and e.persistent:
+                    self.storage.save(e.type_name, e.id, e.persistent_data())
+                gwutils.run_panicless(e.destroy, logger=self.log)
+            self._stop.set()
+
+        if self._thread is not None and self._thread.is_alive():
+            self.rt.post.post(terminate)
+            self._thread.join(timeout=10)
+            if self._thread.is_alive():  # logic thread wedged; force the flag
+                self._stop.set()
+        else:
+            terminate()
+        if self.storage is not None:
+            self.storage.wait_idle(5.0)
         self.cluster.stop()
 
     def _register_to_dispatcher(self, conn: GWConnection):

@@ -1,0 +1,62 @@
+"""Publish/subscribe service (reference: ext/pubsub/PublishSubscribeService.go
+-- a cluster-singleton service entity holding a subject tree with trailing-*
+wildcard subscriptions; state round-trips through attrs so it survives
+freeze/restore).
+
+Subjects are dot-free opaque strings; a subscription ending in ``*`` matches
+every subject with that prefix (reference semantics).  Publish fans out to
+subscriber entities via ``on_published(subject, *args)``.
+"""
+
+from __future__ import annotations
+
+from ..engine.entity import Entity
+from ..engine.rpc import rpc
+
+
+class PublishSubscribeService(Entity):
+    persistent = False
+
+    def on_init(self):
+        # attrs-backed so OnFreeze/OnRestored round-trips the subscriptions
+        # (reference: PublishSubscribeService.go OnFreeze/OnRestored)
+        self.attrs.get_map("exact")      # subject -> {eid: 1}
+        self.attrs.get_map("wildcard")   # prefix  -> {eid: 1}
+
+    @rpc
+    def subscribe(self, eid: str, subject: str):
+        tree, key = self._tree_key(subject)
+        tree.get_map(key).set(eid, 1)
+
+    @rpc
+    def unsubscribe(self, eid: str, subject: str):
+        tree, key = self._tree_key(subject)
+        if key in tree:
+            subs = tree.get_map(key)
+            if eid in subs:
+                subs.delete(eid)
+
+    @rpc
+    def publish(self, subject: str, *args):
+        targets: set[str] = set()
+        exact = self.attrs.get_map("exact")
+        if subject in exact:
+            targets.update(exact.get_map(subject).keys())
+        for prefix in self.attrs.get_map("wildcard").keys():
+            if subject.startswith(prefix):
+                targets.update(
+                    self.attrs.get_map("wildcard").get_map(prefix).keys()
+                )
+        game = getattr(self._runtime(), "game", None)
+        for eid in sorted(targets):
+            if game is not None:
+                game.call_entity(eid, "on_published", subject, *args)
+            else:
+                e = self.manager.get(eid)
+                if e is not None:
+                    e.call("on_published", subject, *args)
+
+    def _tree_key(self, subject: str):
+        if subject.endswith("*"):
+            return self.attrs.get_map("wildcard"), subject[:-1]
+        return self.attrs.get_map("exact"), subject

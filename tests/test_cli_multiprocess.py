@@ -1,0 +1,139 @@
+"""Multi-process cluster e2e through the operator CLI (reference model:
+.travis.yml -- goworld start; test_client -strict; goworld reload;
+test_client again; goworld stop).  Real OS processes, real TCP."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def rundir(tmp_path):
+    disp_port, gate_port = free_port(), free_port()
+    cfg = tmp_path / "goworld.ini"
+    cfg.write_text(
+        f"""
+[deployment]
+dispatchers = 1
+games = 2
+gates = 1
+
+[dispatcher1]
+host = 127.0.0.1
+port = {disp_port}
+
+[game_common]
+boot_entity = Player
+aoi_backend = cpu
+position_sync_interval_ms = 50
+
+[gate1]
+host = 127.0.0.1
+port = {gate_port}
+"""
+    )
+    yield tmp_path, str(cfg), gate_port
+    subprocess.run(
+        [sys.executable, "-m", "goworld_tpu.cli", "kill", "-d", str(tmp_path / "run")],
+        cwd=REPO, env=_env(), capture_output=True,
+    )
+
+
+def _env():
+    env = os.environ.copy()
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU tunnel in subprocesses
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def cli(args, timeout=90):
+    return subprocess.run(
+        [sys.executable, "-m", "goworld_tpu.cli", *args],
+        cwd=REPO, env=_env(), capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_cli_start_bots_reload_stop(rundir):
+    tmp_path, cfg, gate_port = rundir
+    run = str(tmp_path / "run")
+    script = os.path.join(REPO, "examples", "unity_demo", "server.py")
+
+    r = cli(["start", "-c", cfg, "-s", script, "-d", run])
+    assert r.returncode == 0, f"start failed:\n{r.stdout}\n{r.stderr}"
+
+    r = cli(["status", "-d", run])
+    assert r.returncode == 0 and r.stdout.count("RUNNING") == 4, r.stdout
+
+    # strict bots against the live cluster
+    bots = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "test_client.py"),
+         "--gate", f"127.0.0.1:{gate_port}", "-N", "8",
+         "--duration", "3", "--strict"],
+        cwd=REPO, env=_env(), capture_output=True, text=True, timeout=90,
+    )
+    assert bots.returncode == 0, f"bots failed:\n{bots.stdout}\n{bots.stderr}"
+    assert "8/8 bots OK" in bots.stdout
+
+    # hot reload with a client CONNECTED THROUGH IT: its avatar state must
+    # survive the freeze/restore (this is what distinguishes reload from a
+    # cold restart)
+    sys.path.insert(0, REPO)
+    from goworld_tpu.client import GameClientConnection
+
+    keeper = GameClientConnection(("127.0.0.1", gate_port))
+    assert keeper.wait_for(lambda c: c.player is not None, 15)
+    keeper.call_player("enter_game", "keeper")
+    assert keeper.wait_for(
+        lambda c: c.player.attrs.get("name") == "keeper", 15
+    )
+
+    r = cli(["reload", "-c", cfg, "-s", script, "-d", run])
+    assert r.returncode == 0, f"reload failed:\n{r.stdout}\n{r.stderr}\n" + _logs(run)
+
+    # the avatar survived the freeze with its attrs; the connection never broke
+    keeper.call_player("whoami")
+    assert keeper.wait_for(
+        lambda c: any(
+            ("on_whoami", ("keeper",)) in e.calls for e in c.entities.values()
+        ),
+        15,
+    ), "avatar state lost across reload\n" + _logs(run)
+    keeper.close()
+
+    # cluster still serves strict bots after the reload
+    bots = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", "test_client.py"),
+         "--gate", f"127.0.0.1:{gate_port}", "-N", "4",
+         "--duration", "3", "--strict"],
+        cwd=REPO, env=_env(), capture_output=True, text=True, timeout=90,
+    )
+    assert bots.returncode == 0, f"post-reload bots failed:\n{bots.stdout}\n{bots.stderr}\n" + _logs(run)
+
+    r = cli(["stop", "-d", run])
+    assert r.returncode == 0
+    time.sleep(0.5)
+    r = cli(["status", "-d", run])
+    assert "RUNNING" not in r.stdout
+
+
+def _logs(run):
+    out = []
+    for fn in sorted(os.listdir(run)):
+        if fn.endswith(".log"):
+            out.append(f"--- {fn} ---\n" + open(os.path.join(run, fn)).read()[-3000:])
+    return "\n".join(out)
