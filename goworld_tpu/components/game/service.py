@@ -28,6 +28,7 @@ from ...engine.space import Space
 from ...engine.vector import Vector3
 from ...netutil import Packet
 from ...proto import GWConnection, msgtypes as MT
+from ...utils.asyncjobs import JobError
 from ...utils import gwlog, gwutils
 
 
@@ -273,6 +274,12 @@ class GameService:
             self.log.error("load_entity: no storage attached")
             return
         def on_loaded(data):
+            if isinstance(data, JobError):
+                # Never create over a read failure -- the entity may exist
+                # on disk; a fresh instance would overwrite it on next save.
+                self.log.error("load_entity: %s/%s read failed: %r",
+                               type_name, eid, data.exception)
+                return
             if data is None:
                 self.log.warning("load_entity: %s/%s not found", type_name, eid)
                 return

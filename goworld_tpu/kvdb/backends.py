@@ -1,0 +1,128 @@
+"""KVDB backends.
+
+Backend interface (reference: kvdb/types/kvdb_types.go:4-25):
+``get(key) -> str|None``, ``put(key, val)``, ``find(begin, end) ->
+list[(key, val)]`` over the half-open range ``[begin, end)`` in key order,
+``close()``.  ``get_or_put`` is provided on the base class from get/put;
+backends with native compare-and-set may override it.
+
+``filesystem`` is an append-only log (one JSON record per line) replayed
+into a dict on open -- hermetic, crash-safe (partial trailing lines are
+discarded), and compacted when the log grows well past the live key count.
+The reference ships redis/mongo/mysql backends behind this same seam; they
+plug in via ``register_backend``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+class KVDBBackend:
+    def get(self, key: str) -> str | None:
+        raise NotImplementedError
+
+    def put(self, key: str, val: str) -> None:
+        raise NotImplementedError
+
+    def find(self, begin: str, end: str) -> list[tuple[str, str]]:
+        raise NotImplementedError
+
+    def get_or_put(self, key: str, val: str) -> str | None:
+        """Return the existing value, or write ``val`` and return None
+        (reference: kvdb.go GetOrPut).  Atomic because the service runs
+        all ops on one ordered worker."""
+        cur = self.get(key)
+        if cur is not None:
+            return cur
+        self.put(key, val)
+        return None
+
+    def close(self) -> None:
+        pass
+
+
+_COMPACT_MIN_LOG = 1024  # don't bother compacting tiny logs
+
+
+class FilesystemKVDB(KVDBBackend):
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "kvdb.log")
+        self.data: dict[str, str] = {}
+        self._log_records = 0
+        self._replay()
+        self._compact_if_worthwhile()
+        self._log = open(self.path, "a", encoding="utf-8")
+
+    def _replay(self):
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn trailing write
+                    self.data[rec["k"]] = rec["v"]
+                    self._log_records += 1
+        except FileNotFoundError:
+            pass
+
+    def _compaction_due(self) -> bool:
+        return (self._log_records >= _COMPACT_MIN_LOG
+                and self._log_records >= 4 * max(1, len(self.data)))
+
+    def _compact_if_worthwhile(self):
+        if not self._compaction_due():
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for k in sorted(self.data):
+                f.write(json.dumps({"k": k, "v": self.data[k]}) + "\n")
+        os.replace(tmp, self.path)
+        self._log_records = len(self.data)
+
+    def get(self, key: str) -> str | None:
+        return self.data.get(key)
+
+    def put(self, key: str, val: str) -> None:
+        self.data[key] = val
+        self._log.write(json.dumps({"k": key, "v": val}) + "\n")
+        self._log.flush()
+        self._log_records += 1
+        if self._compaction_due():
+            # The live handle must be reopened even if compaction fails
+            # (disk full writing the tmp file) -- the pre-compaction log is
+            # still intact and later puts must keep appending to it.
+            self._log.close()
+            try:
+                self._compact_if_worthwhile()
+            finally:
+                self._log = open(self.path, "a", encoding="utf-8")
+
+    def find(self, begin: str, end: str) -> list[tuple[str, str]]:
+        return [(k, self.data[k]) for k in sorted(self.data)
+                if begin <= k < end]
+
+    def close(self) -> None:
+        self._log.close()
+
+
+_REGISTRY = {"filesystem": FilesystemKVDB}
+
+
+def register_backend(name: str, cls):
+    _REGISTRY[name] = cls
+
+
+def new_kvdb_backend(backend: str, **kwargs) -> KVDBBackend:
+    cls = _REGISTRY.get(backend)
+    if cls is None:
+        raise ValueError(
+            f"unknown kvdb backend {backend!r} (have {sorted(_REGISTRY)})"
+        )
+    return cls(**kwargs)

@@ -7,9 +7,14 @@ framework (reference hot path: /root/reference/engine/entity/Space.go:253-261
 ``aoiMgr.Moved`` + Entity.go:1221-1267 sync collection, batched per tick).
 
 Layout (see aoi_predicate): planar packed words [C, W], W = C/32, where bit k
-of word [i, w] is the interest of entity i in entity j = k*W + w.  Bit-plane k
-is therefore the *contiguous* column slice [k*W, (k+1)*W) -- the kernel packs
-by looping k over 32 contiguous lane-aligned slices (no strided access).
+of word [i, w] is the interest of entity i in entity j = k*W + w.  The kernel
+computes the full [TI, C] mask block on the VPU, then packs it on the MXU:
+``words = mask @ P`` where the constant banded matrix ``P[j, ws] = 2^(j//W)``
+iff ``j % W == ws``.  Because 2^31 exceeds exact f32 range the matmul is split
+into four byte planes (weights <= 128, partial sums <= 255 -- exact in f32)
+recombined with integer shifts.  This shape avoids the two Mosaic limits that
+rule out the direct formulations: dynamic lane-dim slices must be 128-aligned,
+and 2D->3D vector reshapes are unsupported.
 
 Active handling is folded into the inputs by the wrapper so the kernel has no
 mask operand:
@@ -38,26 +43,34 @@ _INF = float("inf")
 
 def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_out, *, ti, w):
     bi = pl.program_id(1)
-    xr = x_row[0].reshape(ti, 1)
-    zr = z_row[0].reshape(ti, 1)
-    rr = r_row[0].reshape(ti, 1)
+    c = WORD_BITS * w
+    xr = x_row[0, 0].reshape(ti, 1)
+    zr = z_row[0, 0].reshape(ti, 1)
+    rr = r_row[0, 0].reshape(ti, 1)
+    xc = x_col[0, 0].reshape(1, c)
+    zc = z_col[0, 0].reshape(1, c)
     row_ids = bi * ti + jax.lax.broadcasted_iota(jnp.int32, (ti, 1), 0)
-    col_base = jax.lax.broadcasted_iota(jnp.int32, (1, w), 1)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (ti, c), 1)
+    m = (jnp.abs(xc - xr) <= rr) & (jnp.abs(zc - zr) <= rr)
+    m &= row_ids != col_ids
+    mf = m.astype(jnp.float32)
 
-    def plane(k, acc):
-        xc = x_col[0, pl.ds(k * w, w)].reshape(1, w)
-        zc = z_col[0, pl.ds(k * w, w)].reshape(1, w)
-        m = (jnp.abs(xc - xr) <= rr) & (jnp.abs(zc - zr) <= rr)
-        m &= row_ids != k * w + col_base
-        return acc | (m.astype(jnp.uint32) << k.astype(jnp.uint32))
-
-    acc = jax.lax.fori_loop(
-        0, WORD_BITS, plane, jnp.zeros((ti, w), jnp.uint32)
-    )
+    # Pack on the MXU, one byte plane per matmul (see module docstring).
+    j_ids = jax.lax.broadcasted_iota(jnp.int32, (c, w), 0)
+    ws_ids = jax.lax.broadcasted_iota(jnp.int32, (c, w), 1)
+    k_ids = j_ids // w
+    hit = (j_ids % w) == ws_ids
+    acc = jnp.zeros((ti, w), jnp.int32)
+    for b in range(4):
+        band = hit & (k_ids >= 8 * b) & (k_ids < 8 * (b + 1))
+        pb = jnp.where(band, jnp.exp2((k_ids - 8 * b).astype(jnp.float32)), 0.0)
+        byte = jax.lax.dot(mf, pb, preferred_element_type=jnp.float32)
+        acc = acc | (byte.astype(jnp.int32) << (8 * b))
+    accu = jax.lax.bitcast_convert_type(acc, jnp.uint32)
     pw = prev[0]
-    new_out[0] = acc
-    ent_out[0] = acc & ~pw
-    lv_out[0] = pw & ~acc
+    new_out[0] = accu
+    ent_out[0] = accu & ~pw
+    lv_out[0] = pw & ~accu
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
@@ -70,18 +83,26 @@ def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128, interpr
     """
     s, c = x.shape
     w = words_per_row(c)
+    # Legalize the row-block hint: the row slice rides the lane dim, so a
+    # partial block must be a 128-multiple that divides C; else use full C.
     ti = min(block_rows, c)
-    assert c % ti == 0, (c, ti)
+    if ti != c:
+        ti = (ti // 128) * 128
+        if ti == 0 or c % ti != 0:
+            ti = c
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
     # Fold activity into coordinates/radius (exact; see module docstring).
-    x_eff = jnp.where(active, x, jnp.float32(_INF))
-    z_eff = jnp.where(active, z, jnp.float32(_INF))
-    r_eff = jnp.where(active, radius, jnp.float32(-1.0))
+    # The [S, 1, C] layout keeps every block's trailing dims either equal to
+    # the array dims or lane/sublane aligned -- the Mosaic tiling rule that a
+    # 2D [S, C] layout breaks whenever S is not a multiple of 8.
+    x_eff = jnp.where(active, x, jnp.float32(_INF)).reshape(s, 1, c)
+    z_eff = jnp.where(active, z, jnp.float32(_INF)).reshape(s, 1, c)
+    r_eff = jnp.where(active, radius, jnp.float32(-1.0)).reshape(s, 1, c)
 
-    row_spec = pl.BlockSpec((1, ti), lambda si, bi: (si, bi))
-    col_spec = pl.BlockSpec((1, c), lambda si, bi: (si, 0))
+    row_spec = pl.BlockSpec((1, 1, ti), lambda si, bi: (si, 0, bi))
+    col_spec = pl.BlockSpec((1, 1, c), lambda si, bi: (si, 0, 0))
     words_spec = pl.BlockSpec((1, ti, w), lambda si, bi: (si, bi, 0))
     out_shape = jax.ShapeDtypeStruct((s, c, w), jnp.uint32)
 

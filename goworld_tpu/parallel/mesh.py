@@ -25,14 +25,36 @@ from ..ops.aoi_pallas import aoi_step_pallas
 from ..ops.aoi_dense import aoi_step_dense_batched
 
 
+def multichip_devices(n: int | None = None):
+    """Devices for a space mesh: the default backend if it has enough chips,
+    else the host-CPU backend (8 virtual devices under
+    ``--xla_force_host_platform_device_count=8`` -- the single-real-chip dev
+    setup).  ``n=None`` means "as many as the default backend offers"."""
+    devs = jax.devices()
+    if n is None:
+        return devs
+    if len(devs) >= n:
+        return devs[:n]
+    try:
+        cpu = jax.devices("cpu")
+    except RuntimeError:
+        cpu = []
+    if len(cpu) >= n:
+        return cpu[:n]
+    raise RuntimeError(
+        f"need {n} devices; default backend has {len(devs)}, cpu has {len(cpu)}"
+    )
+
+
 class SpaceMesh:
     """A 1-D mesh over which space batches shard."""
 
     def __init__(self, devices=None, axis: str = "space"):
-        devices = devices if devices is not None else jax.devices()
+        devices = devices if devices is not None else multichip_devices()
         self.axis = axis
         self.mesh = Mesh(list(devices), (axis,))
         self.n_devices = len(devices)
+        self.platform = devices[0].platform
 
     def sharding(self) -> NamedSharding:
         """NamedSharding that splits the leading (space) axis."""
@@ -52,11 +74,15 @@ def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
     """
     mesh = space_mesh.mesh
     axis = space_mesh.axis
+    # Interpret must follow the MESH's platform, not the default backend --
+    # a cpu mesh under a tpu-default process still needs interpret mode.
+    interpret = space_mesh.platform != "tpu"
 
     def _local(x, z, r, act, prev):
         if use_pallas:
             new, ent, lv = aoi_step_pallas(x, z, r, act, prev,
-                                           block_rows=block_rows)
+                                           block_rows=block_rows,
+                                           interpret=interpret)
         else:
             new, ent, lv = aoi_step_dense_batched(x, z, r, act, prev)
         local_events = jnp.sum(

@@ -21,6 +21,7 @@ import random
 
 from .engine.ids import gen_id
 from .utils import gwlog, gwutils
+from .utils.asyncjobs import JobError
 
 SRVID_PREFIX = "service/"
 CHECK_INTERVAL = 1.0
@@ -87,6 +88,10 @@ class ServiceManager:
         storage = self.game.storage
         if persistent and storage is not None:
             def on_loaded(data, type_name=type_name, eid=eid):
+                if isinstance(data, JobError):
+                    self.log.error("service %s load failed: %r",
+                                   type_name, data.exception)
+                    return
                 if self.game.rt.entities.get(eid) is None:
                     self.game.rt.entities.create(
                         type_name, eid=eid, attrs=data or {}

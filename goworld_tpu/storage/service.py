@@ -1,20 +1,23 @@
 """The async storage worker (reference: storage.go:66-286).
 
-One daemon thread drains the op queue in order.  Saves retry with backoff
-until they succeed (the reference retries forever -- an entity save must not
-be lost).  Completion callbacks are delivered through ``post`` so they run on
-the caller's logic thread, never the worker.
+One ``OrderedWorker`` drains the op queue in order.  Saves retry with
+backoff until they succeed (the reference retries forever -- an entity save
+must not be lost); the retry loop aborts only on close.  Completion
+callbacks are delivered through ``post`` so they run on the caller's logic
+thread, never the worker.  Read-style ops deliver a ``JobError`` to their
+callback if the backend raised.
 """
 
 from __future__ import annotations
 
-import queue
-import threading
 import time
 from typing import Callable
 
 from ..utils import gwlog
+from ..utils.asyncjobs import JobError, OrderedWorker
 from .backends import EntityStorageBackend
+
+__all__ = ["EntityStorageService", "JobError"]
 
 _SAVE_RETRY_BACKOFF = 1.0
 QUEUE_WARN_LEN = 1000  # reference: storage queue-length warnings
@@ -27,85 +30,52 @@ class EntityStorageService:
         post: Callable[[Callable], None] | None = None,
     ):
         self.backend = backend
-        self.post = post or (lambda fn: fn())
-        self.queue: "queue.Queue[tuple]" = queue.Queue()
         self.log = gwlog.logger("storage")
-        self._idle = threading.Event()
-        self._idle.set()
-        self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
+        self._worker = OrderedWorker("storage", post=post)
 
     # -- API (async; callbacks on the logic thread) ------------------------
     def save(self, type_name: str, eid: str, data: dict,
              callback: Callable[[], None] | None = None):
-        self._put(("save", type_name, eid, data, callback))
+        cb = (lambda _r: callback()) if callback is not None else None
+        self._submit(
+            lambda: self._save_with_retry(type_name, eid, data), cb
+        )
 
     def load(self, type_name: str, eid: str,
-             callback: Callable[[dict | None], None]):
-        self._put(("load", type_name, eid, None, callback))
+             callback: Callable[[object], None]):
+        self._submit(lambda: self.backend.read(type_name, eid), callback)
 
     def exists(self, type_name: str, eid: str,
-               callback: Callable[[bool], None]):
-        self._put(("exists", type_name, eid, None, callback))
+               callback: Callable[[object], None]):
+        self._submit(lambda: self.backend.exists(type_name, eid), callback)
 
     def list_entity_ids(self, type_name: str,
-                        callback: Callable[[list], None]):
-        self._put(("list", type_name, "", None, callback))
+                        callback: Callable[[object], None]):
+        self._submit(lambda: self.backend.list_entity_ids(type_name), callback)
 
-    def _put(self, op):
-        self._idle.clear()
-        self.queue.put(op)
-        if self.queue.qsize() > QUEUE_WARN_LEN:
-            self.log.warning("storage queue depth %d", self.queue.qsize())
+    def _submit(self, op, callback):
+        self._worker.submit(op, callback)
+        depth = self._worker.pending()
+        if depth > QUEUE_WARN_LEN:
+            self.log.warning("storage queue depth %d", depth)
 
     def wait_idle(self, timeout: float | None = None) -> bool:
-        return self._idle.wait(timeout)
+        return self._worker.wait_clear(timeout)
 
     def close(self):
-        self._stop.set()
-        self.queue.put(None)
-        self._thread.join(timeout=5)
+        self._worker.close()
         self.backend.close()
-
-    # -- worker ------------------------------------------------------------
-    def _worker(self):
-        while not self._stop.is_set():
-            op = self.queue.get()
-            if op is None:
-                break
-            kind, type_name, eid, data, callback = op
-            try:
-                if kind == "save":
-                    self._save_with_retry(type_name, eid, data)
-                    result = None
-                elif kind == "load":
-                    result = self.backend.read(type_name, eid)
-                elif kind == "exists":
-                    result = self.backend.exists(type_name, eid)
-                elif kind == "list":
-                    result = self.backend.list_entity_ids(type_name)
-                else:
-                    continue
-            except Exception:
-                self.log.exception("storage op %s failed", kind)
-                result = None
-            if callback is not None:
-                if kind == "save":
-                    self.post(callback)
-                else:
-                    self.post(lambda cb=callback, r=result: cb(r))
-            if self.queue.empty():
-                self._idle.set()
 
     def _save_with_retry(self, type_name: str, eid: str, data: dict):
         """Reference semantics: infinite retry -- saves must not be lost
         (storage.go save loop)."""
-        while not self._stop.is_set():
+        while True:
             try:
                 self.backend.write(type_name, eid, data)
                 return
             except Exception:
+                if self._worker.stopping.is_set():
+                    raise
                 self.log.exception(
                     "save %s/%s failed; retrying in %.1fs",
                     type_name, eid, _SAVE_RETRY_BACKOFF,

@@ -1,12 +1,16 @@
-"""Test config: force JAX onto a virtual 8-device CPU mesh.
+"""Test config: make an 8-device virtual CPU backend available for the
+multi-chip sharding tests.
 
 Must run before anything imports jax, hence the env mutation at module import
-time (pytest imports conftest first).
+time (pytest imports conftest first).  The default platform is NOT forced:
+with a real TPU attached (axon pins JAX_PLATFORMS, overriding any value set
+here) the single-chip kernel tests run on genuine hardware, while mesh tests
+reach the 8 virtual devices through ``jax.devices("cpu")``
+(parallel.multichip_devices).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

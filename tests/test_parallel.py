@@ -11,9 +11,9 @@ def test_sharded_step_matches_single_device():
     import jax.numpy as jnp
 
     from goworld_tpu.ops import aoi_step_dense_batched, round_capacity, words_per_row
-    from goworld_tpu.parallel import SpaceMesh, make_sharded_aoi_step
+    from goworld_tpu.parallel import SpaceMesh, make_sharded_aoi_step, multichip_devices
 
-    assert len(jax.devices()) == 8, "conftest must force 8 cpu devices"
+    devices = multichip_devices(8)
     cap = round_capacity(128)
     w = words_per_row(cap)
     S = 16  # 2 spaces per device
@@ -24,7 +24,7 @@ def test_sharded_step_matches_single_device():
     act = rng.random((S, cap)) < 0.8
     prev = np.zeros((S, cap, w), np.uint32)
 
-    sm = SpaceMesh()
+    sm = SpaceMesh(devices)
     step = make_sharded_aoi_step(sm, use_pallas=True)
     xs, zs, rs = sm.device_put(x), sm.device_put(z), sm.device_put(r)
     acts, prevs = sm.device_put(act), sm.device_put(prev)
