@@ -93,6 +93,7 @@ class DispatcherService:
         self.ready = False
         self._blocked_eids: set[str] = set()  # entities with block/pending state
         self._boot_rr = 0
+        self._pending_boots: list[tuple] = []
         self._listener = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -193,6 +194,7 @@ class DispatcherService:
             gi.frozen = False
             self._unblock_game(gi)
         self.log.info("game%d connected (%d entities, restore=%s)", gid, n, is_restore)
+        self._drain_pending_boots()
         self._check_ready()
 
     def _h_set_gate_id(self, peer, pkt):
@@ -232,12 +234,19 @@ class DispatcherService:
         # (reference: chooseGameForBootEntity, :545-558)
         client_id = pkt.read_client_id()
         boot_eid = pkt.read_entity_id()
+        self._place_boot(client_id, boot_eid, peer.id)
+
+    def _place_boot(self, client_id, boot_eid, gate_id):
         gids = sorted(
             gid for gid, gi in self.games.items()
             if gi.conn and gi.conn.alive and not gi.frozen
         )
         if not gids:
-            self.log.error("no game available for boot entity")
+            # no game yet (cluster still forming): hold the boot request and
+            # replay it when a game registers, instead of dropping the
+            # client's one-shot boot message
+            self.log.warning("no game available for boot entity; queueing")
+            self._pending_boots.append((client_id, boot_eid, gate_id))
             return
         gid = gids[self._boot_rr % len(gids)]
         self._boot_rr += 1
@@ -246,12 +255,21 @@ class DispatcherService:
         out = Packet.for_msgtype(MT.MT_NOTIFY_CLIENT_CONNECTED)
         out.append_client_id(client_id)
         out.append_entity_id(boot_eid)
-        out.append_u16(peer.id)  # gate id appended for the game
+        out.append_u16(gate_id)  # gate id appended for the game
         self._send_to_game(gid, out)
+
+    def _drain_pending_boots(self):
+        pending, self._pending_boots = self._pending_boots, []
+        for client_id, boot_eid, gate_id in pending:
+            self._place_boot(client_id, boot_eid, gate_id)
 
     def _h_notify_client_disconnected(self, peer, pkt):
         client_id = pkt.read_client_id()
         owner_eid = pkt.read_entity_id()
+        if self._pending_boots:
+            self._pending_boots = [
+                b for b in self._pending_boots if b[0] != client_id
+            ]
         ei = self.entities.get(owner_eid)
         if ei and ei.game_id:
             out = Packet.for_msgtype(MT.MT_NOTIFY_CLIENT_DISCONNECTED)

@@ -78,6 +78,8 @@ class GateService:
         )
         # client->server position syncs batched per dispatcher
         self._sync_batches: dict[int, Packet] = {}
+        # boot requests awaiting a live dispatcher connection
+        self._pending_boots: list[ClientProxy] = []
         self._listener = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -88,6 +90,14 @@ class GateService:
         self._listener = serve_tcp(self.addr, self._on_client_connection)
         self.addr = self._listener.getsockname()
         self.cluster.start()
+        # don't announce readiness until the dispatchers are reachable --
+        # otherwise the operator CLI lets clients in while boot-entity
+        # requests would still be dropped on the floor
+        if not self.cluster.wait_connected(30.0):
+            self.log.warning(
+                "dispatchers unreachable after 30s; announcing ready anyway "
+                "(boot requests will queue until they connect)"
+            )
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         gwlog.announce_ready(f"gate{self.id}", "gate")
@@ -133,6 +143,7 @@ class GateService:
                 self._flush_sync_batches()
                 next_sync = now + sync_s
             if now >= flush_deadline:
+                self._retry_pending_boots()
                 for cp in self.clients.values():
                     cp.flush()
                 self.cluster.flush_all()
@@ -161,12 +172,29 @@ class GateService:
         cp.flush()
         # boot entity id is generated ON THE GATE (reference:
         # onNewClientProxy, GateService.go:214-219)
-        boot_eid = gen_id()
-        cp.owner_entity_id = boot_eid
-        conn = self.cluster.by_entity(boot_eid)
-        if conn:
-            conn.send_notify_client_connected(cp.client_id, boot_eid)
+        cp.owner_entity_id = gen_id()
+        if not self._send_boot(cp):
+            self._pending_boots.append(cp)
+
+    def _send_boot(self, cp: ClientProxy) -> bool:
+        conn = self.cluster.by_entity(cp.owner_entity_id)
+        if conn is None:
+            return False
+        try:
+            conn.send_notify_client_connected(cp.client_id, cp.owner_entity_id)
             conn.flush()
+        except OSError:
+            return False
+        return True
+
+    def _retry_pending_boots(self):
+        if not self._pending_boots:
+            return
+        still = [
+            cp for cp in self._pending_boots
+            if cp.alive and not self._send_boot(cp)
+        ]
+        self._pending_boots = still
 
     def _on_client_gone(self, cp: ClientProxy):
         cp.alive = False

@@ -97,10 +97,14 @@ class FilesystemKVDB(KVDBBackend):
         if self._compaction_due():
             # The live handle must be reopened even if compaction fails
             # (disk full writing the tmp file) -- the pre-compaction log is
-            # still intact and later puts must keep appending to it.
+            # still intact and later puts must keep appending to it.  A
+            # compaction failure must not fail the put: the record above is
+            # already durable.
             self._log.close()
             try:
                 self._compact_if_worthwhile()
+            except OSError:
+                pass
             finally:
                 self._log = open(self.path, "a", encoding="utf-8")
 

@@ -36,7 +36,13 @@ class EntityStorageService:
     # -- API (async; callbacks on the logic thread) ------------------------
     def save(self, type_name: str, eid: str, data: dict,
              callback: Callable[[], None] | None = None):
-        cb = (lambda _r: callback()) if callback is not None else None
+        # only signal completion on success -- an aborted save (JobError at
+        # shutdown) must not look like a durable write to the caller
+        cb = None
+        if callback is not None:
+            def cb(result, _callback=callback):
+                if not isinstance(result, JobError):
+                    _callback()
         self._submit(
             lambda: self._save_with_retry(type_name, eid, data), cb
         )
