@@ -21,9 +21,12 @@ from goworld_tpu.client import GameClientConnection
 
 
 class Bot(threading.Thread):
-    def __init__(self, addr, idx, duration, strict, stats):
+    def __init__(self, addr, idx, duration, strict, stats, transport="tcp",
+                 tls=False):
         super().__init__(daemon=True)
         self.addr = addr
+        self.transport = transport
+        self.tls = tls
         self.idx = idx
         self.duration = duration
         self.strict = strict
@@ -47,7 +50,7 @@ class Bot(threading.Thread):
     def _run(self):
         rng = random.Random(self.idx)
         t0 = time.perf_counter()
-        c = GameClientConnection(self.addr)
+        c = GameClientConnection(self.addr, transport=self.transport, tls=self.tls)
         self._assert(
             c.wait_for(lambda c: c.player is not None, 15), "no boot entity"
         )
@@ -101,11 +104,14 @@ def main():
     ap.add_argument("-N", type=int, default=10)
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--strict", action="store_true")
+    ap.add_argument("--transport", default="tcp", choices=["tcp", "ws", "kcp"])
+    ap.add_argument("--tls", action="store_true")
     args = ap.parse_args()
     host, port = args.gate.rsplit(":", 1)
     addr = (host, int(port))
     stats = Stats()
-    bots = [Bot(addr, i, args.duration, args.strict, stats) for i in range(args.N)]
+    bots = [Bot(addr, i, args.duration, args.strict, stats,
+                transport=args.transport, tls=args.tls) for i in range(args.N)]
     for b in bots:
         b.start()
         time.sleep(0.01)

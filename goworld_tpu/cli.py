@@ -55,27 +55,35 @@ def _read_pids(rundir: str) -> dict[str, int]:
     return out
 
 
-def _spawn(rundir: str, name: str, argv: list[str]) -> int:
-    log = open(_logfile(rundir, name), "ab")
+def _spawn(rundir: str, name: str, argv: list[str]) -> tuple[int, int]:
+    """Returns (pid, log_offset): the log size before this process appends,
+    so readiness watching ignores tags left by previous runs in the same
+    rundir."""
+    path = _logfile(rundir, name)
+    offset = os.path.getsize(path) if os.path.exists(path) else 0
+    log = open(path, "ab")
     proc = subprocess.Popen(
         argv, stdout=log, stderr=subprocess.STDOUT, cwd=rundir,
         start_new_session=True,
     )
     with open(_pidfile(rundir, name), "w") as f:
         f.write(str(proc.pid))
-    return proc.pid
+    return proc.pid, offset
 
 
-def _wait_ready(rundir: str, name: str, timeout: float = 30.0) -> bool:
-    """Watch the component's log for the readiness tag."""
+def _wait_ready(rundir: str, name: str, offset: int = 0,
+                timeout: float = 30.0) -> bool:
+    """Watch the component's log (past ``offset``) for the readiness tag.
+    Only content this run appended counts -- logs accumulate across runs."""
     path = _logfile(rundir, name)
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
             with open(path, "rb") as f:
+                f.seek(offset)
                 if READY_TAG.encode() in f.read():
                     return True
-        except FileNotFoundError:
+        except OSError:
             pass
         time.sleep(0.05)
     return False
@@ -104,12 +112,14 @@ def cmd_start(args) -> int:
         return 1
     py = sys.executable
 
+    offsets: dict[str, int] = {}
     for i in cfg.dispatchers:
         name = f"dispatcher{i}"
-        _spawn(args.dir, name, [py, "-m", "goworld_tpu.components.dispatcher",
-                                "-dispid", str(i), "-configfile", config_abs])
+        _pid, offsets[name] = _spawn(
+            args.dir, name, [py, "-m", "goworld_tpu.components.dispatcher",
+                             "-dispid", str(i), "-configfile", config_abs])
     for i in cfg.dispatchers:
-        if not _wait_ready(args.dir, f"dispatcher{i}"):
+        if not _wait_ready(args.dir, f"dispatcher{i}", offsets[f"dispatcher{i}"]):
             return _fail_and_teardown(args.dir, f"dispatcher{i} failed to become ready")
     for i in cfg.games:
         name = f"game{i}"
@@ -117,16 +127,17 @@ def cmd_start(args) -> int:
                 "-configfile", config_abs, "-script", script_abs, "-dir", "."]
         if args.restore:
             argv.append("-restore")
-        _spawn(args.dir, name, argv)
+        _pid, offsets[name] = _spawn(args.dir, name, argv)
     for i in cfg.games:
-        if not _wait_ready(args.dir, f"game{i}"):
+        if not _wait_ready(args.dir, f"game{i}", offsets[f"game{i}"]):
             return _fail_and_teardown(args.dir, f"game{i} failed to become ready")
     for i in cfg.gates:
         name = f"gate{i}"
-        _spawn(args.dir, name, [py, "-m", "goworld_tpu.components.gate",
-                                "-gateid", str(i), "-configfile", config_abs])
+        _pid, offsets[name] = _spawn(
+            args.dir, name, [py, "-m", "goworld_tpu.components.gate",
+                             "-gateid", str(i), "-configfile", config_abs])
     for i in cfg.gates:
-        if not _wait_ready(args.dir, f"gate{i}"):
+        if not _wait_ready(args.dir, f"gate{i}", offsets[f"gate{i}"]):
             return _fail_and_teardown(args.dir, f"gate{i} failed to become ready")
     print(f"cluster up: {len(cfg.dispatchers)} dispatcher(s), "
           f"{len(cfg.games)} game(s), {len(cfg.gates)} gate(s)")
@@ -200,16 +211,16 @@ def cmd_reload(args) -> int:
     config_abs = os.path.abspath(args.config)
     script_abs = os.path.abspath(args.script)
     py = sys.executable
+    offsets: dict[str, int] = {}
     for i in cfg.games:
         name = f"game{i}"
-        # truncate log so the ready-barrier watches the fresh run
-        open(_logfile(args.dir, name), "wb").close()
-        _spawn(args.dir, name,
-               [py, "-m", "goworld_tpu.components.game", "-gid", str(i),
-                "-configfile", config_abs, "-script", script_abs,
-                "-dir", ".", "-restore"])
+        _pid, offsets[name] = _spawn(
+            args.dir, name,
+            [py, "-m", "goworld_tpu.components.game", "-gid", str(i),
+             "-configfile", config_abs, "-script", script_abs,
+             "-dir", ".", "-restore"])
     for i in cfg.games:
-        if not _wait_ready(args.dir, f"game{i}"):
+        if not _wait_ready(args.dir, f"game{i}", offsets[f"game{i}"]):
             print(f"game{i} failed to restore", file=sys.stderr)
             return 1
     print("reload complete")

@@ -17,7 +17,7 @@ import threading
 import time
 
 from .engine.attrs import MapAttr, apply_delta
-from .netutil import Packet, PacketConnection, connect_tcp
+from .netutil import Packet, PacketConnection, connect_tcp, kcp, websocket
 from .proto import msgtypes as MT
 
 
@@ -40,8 +40,35 @@ class GameClientConnection:
     """A connected client.  ``poll()`` drains pending server messages on the
     caller's thread (no background threads -- deterministic for tests)."""
 
-    def __init__(self, addr: tuple[str, int], compression: str = "gwlz"):
-        self.pc = PacketConnection(connect_tcp(addr), compression=compression)
+    def __init__(self, addr: tuple[str, int], compression: str = "gwlz",
+                 transport: str = "tcp", tls: bool = False,
+                 tls_cafile: str | None = None):
+        if transport == "kcp":
+            if tls or tls_cafile:
+                raise ValueError("tls over kcp is not supported")
+            sock = kcp.connect_kcp(addr)
+        elif transport in ("tcp", "ws"):
+            sock = connect_tcp(addr)
+            if tls or tls_cafile:
+                import ssl
+
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                if tls_cafile:
+                    ctx.load_verify_locations(tls_cafile)
+                else:
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl.CERT_NONE
+                sock = ctx.wrap_socket(sock, server_hostname=addr[0])
+            if transport == "ws":
+                residue = websocket.client_handshake(
+                    sock, f"{addr[0]}:{addr[1]}"
+                )
+                sock = websocket.WSSocket(
+                    sock, mask_outgoing=True, residue=residue
+                )
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
+        self.pc = PacketConnection(sock, compression=compression)
         self.client_id: str | None = None
         self.entities: dict[str, ClientEntity] = {}
         self.player: ClientEntity | None = None

@@ -27,7 +27,7 @@ from dataclasses import dataclass, field
 from ...config import ClusterConfig
 from ...netutil import Packet, PacketConnection, serve_tcp
 from ...proto import msgtypes as MT
-from ...utils import gwlog
+from ...utils import binutil, gwlog, gwvar
 
 BLOCKED_ENTITY_QUEUE_MAX = 1000      # reference: consts.go:32
 BLOCKED_GAME_QUEUE_MAX = 1_000_000   # reference: consts.go:30
@@ -84,6 +84,7 @@ class DispatcherService:
         self.id = disp_id
         self.cfg = cfg
         dc = cfg.dispatchers[disp_id]
+        self.dispcfg = dc
         self.addr = (dc.host, dc.port)
         self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=100000)
         self.games: dict[int, _GameInfo] = {}
@@ -103,6 +104,9 @@ class DispatcherService:
     def start(self):
         self._listener = serve_tcp(self.addr, self._on_connection)
         self.addr = self._listener.getsockname()
+        gwvar.set_var("component", f"dispatcher{self.id}")
+        if self.dispcfg.http_port:
+            binutil.setup_http_server(self.dispcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         self.log.info("dispatcher listening on %s", self.addr)
@@ -213,6 +217,7 @@ class DispatcherService:
         have_gates = sum(1 for g in self.gates.values() if g.alive)
         if not self.ready and have_games >= want_games and have_gates >= want_gates:
             self.ready = True
+            gwvar.set_var("is_deployment_ready", True)
             p = Packet.for_msgtype(MT.MT_NOTIFY_DEPLOYMENT_READY)
             self._broadcast_games(p)
             for gate in self.gates.values():
@@ -516,6 +521,11 @@ class DispatcherService:
         elif peer.kind == "gate":
             if self.gates.get(peer.id) is peer:
                 del self.gates[peer.id]
+                # boots queued through the dead gate would replay with a
+                # stale gate id and leak boot entities
+                self._pending_boots = [
+                    b for b in self._pending_boots if b[2] != peer.id
+                ]
                 out = Packet.for_msgtype(MT.MT_NOTIFY_GATE_DISCONNECTED)
                 out.append_u16(peer.id)
                 self._broadcast_games(out)

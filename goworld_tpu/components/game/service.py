@@ -29,7 +29,7 @@ from ...engine.vector import Vector3
 from ...netutil import Packet
 from ...proto import GWConnection, msgtypes as MT
 from ...utils.asyncjobs import JobError
-from ...utils import gwlog, gwutils
+from ...utils import binutil, gwlog, gwutils, gwvar
 
 
 class NilSpace(Space):
@@ -108,6 +108,9 @@ class GameService:
                 "__nil_space__", eid=fixed_id(f"nilspace-game{self.id}")
             )
         self.cluster.start()
+        gwvar.set_var("component", f"game{self.id}")
+        if self.gcfg.http_port:
+            binutil.setup_http_server(self.gcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         gwlog.announce_ready(f"game{self.id}", "game")
@@ -197,6 +200,7 @@ class GameService:
         if self.deployment_ready:
             return
         self.deployment_ready = True
+        gwvar.set_var("is_deployment_ready", True)
         self.log.info("deployment ready")
         for e in list(self.rt.entities.entities.values()):
             gwutils.run_panicless(e.on_game_ready, logger=self.log)

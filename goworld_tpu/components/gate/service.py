@@ -17,15 +17,16 @@ Heartbeat timeout kicks dead clients (reference: :202-212).
 from __future__ import annotations
 
 import queue
+import ssl
 import threading
 import time
 
 from ...config import ClusterConfig
 from ...dispatchercluster import DispatcherCluster
 from ...engine.ids import gen_id
-from ...netutil import Packet, PacketConnection, serve_tcp
+from ...netutil import Packet, PacketConnection, kcp, serve_tcp, websocket
 from ...proto import GWConnection, msgtypes as MT
-from ...utils import gwlog, gwutils
+from ...utils import binutil, gwlog, gwutils, gwvar
 from .filtertree import FilterTree
 
 
@@ -81,14 +82,43 @@ class GateService:
         # boot requests awaiting a live dispatcher connection
         self._pending_boots: list[ClientProxy] = []
         self._listener = None
+        self._ws_listener = None
+        self._kcp_server = None
+        self.kcp_addr: tuple[str, int] | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.addr = (self.gatecfg.host, self.gatecfg.port)
+        self.ws_addr: tuple[str, int] | None = None
+        self._ssl_ctx = None
+        if self.gatecfg.tls_cert and self.gatecfg.tls_key:
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(
+                self.gatecfg.tls_cert, self.gatecfg.tls_key
+            )
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         self._listener = serve_tcp(self.addr, self._on_client_connection)
         self.addr = self._listener.getsockname()
+        if self.gatecfg.websocket_port:
+            # 0 = disabled; negative = ephemeral bind (tests)
+            self._ws_listener = serve_tcp(
+                (self.gatecfg.host, max(self.gatecfg.websocket_port, 0)),
+                self._on_ws_connection,
+            )
+            self.ws_addr = self._ws_listener.getsockname()
+            self.log.info("gate websocket on %s", self.ws_addr)
+        if self.gatecfg.kcp_port:
+            # 0 = disabled; negative = ephemeral bind (tests)
+            self._kcp_server = kcp.serve_kcp(
+                (self.gatecfg.host, max(self.gatecfg.kcp_port, 0)),
+                lambda sess, peer: self._serve_client(sess),
+            )
+            self.kcp_addr = self._kcp_server.addr
+            self.log.info("gate kcp on %s", self.kcp_addr)
+        gwvar.set_var("component", f"gate{self.id}")
+        if self.gatecfg.http_port:
+            binutil.setup_http_server(self.gatecfg.http_port)
         self.cluster.start()
         # don't announce readiness until the dispatchers are reachable --
         # otherwise the operator CLI lets clients in while boot-entity
@@ -109,9 +139,35 @@ class GateService:
         self.cluster.stop()
         if self._listener:
             self._listener.close()
+        if self._ws_listener:
+            self._ws_listener.close()
+        if self._kcp_server:
+            self._kcp_server.close()
 
     # -- client connections ------------------------------------------------
+    def _maybe_tls(self, sock):
+        if self._ssl_ctx is None:
+            return sock
+        return self._ssl_ctx.wrap_socket(sock, server_side=True)
+
     def _on_client_connection(self, sock, peer_addr):
+        try:
+            sock = self._maybe_tls(sock)
+        except (OSError, ValueError):
+            return
+        self._serve_client(sock)
+
+    def _on_ws_connection(self, sock, peer_addr):
+        try:
+            sock = self._maybe_tls(sock)
+            _headers, residue = websocket.server_handshake(sock)
+        except (OSError, ValueError):
+            return
+        self._serve_client(
+            websocket.WSSocket(sock, mask_outgoing=False, residue=residue)
+        )
+
+    def _serve_client(self, sock):
         pc = PacketConnection(sock, compression=self.gatecfg.compression)
         cp = ClientProxy(pc, self)
         self.queue.put(("client_new", cp, None))
@@ -164,6 +220,7 @@ class GateService:
 
     # -- new / dead clients ------------------------------------------------
     def _on_new_client(self, cp: ClientProxy):
+        self.log.info("new client %s", cp.client_id)
         self.clients[cp.client_id] = cp
         # handshake: tell the client its id
         p = Packet.for_msgtype(MT.MT_CLIENT_HANDSHAKE)

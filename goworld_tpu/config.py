@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 class DispatcherConfig:
     host: str = "127.0.0.1"
     port: int = 16001
+    http_port: int = 0
 
 
 @dataclass
@@ -55,11 +56,16 @@ class GateConfig:
     host: str = "127.0.0.1"
     port: int = 17001
     websocket_port: int = 0
+    kcp_port: int = 0
     compression: str = "gwlz"
     heartbeat_timeout_s: float = 30.0
     position_sync_interval_ms: int = 100
     log_file: str = ""
     http_port: int = 0
+    # both set -> TLS on the TCP and WebSocket listeners (reference:
+    # GateService.go:97-118)
+    tls_cert: str = ""
+    tls_key: str = ""
 
 
 @dataclass

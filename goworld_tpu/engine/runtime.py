@@ -22,6 +22,7 @@ from __future__ import annotations
 import time
 from typing import Callable
 
+from ..utils.crontab import Crontab
 from .aoi import AOIEngine
 from .entity import SYNC_NEIGHBORS, SYNC_OWN, Entity
 from .manager import EntityManager
@@ -40,6 +41,7 @@ class Runtime:
         self.on_error = on_error or self._default_on_error
         self.timers = TimerQueue(now)
         self.post = PostQueue()
+        self.crontab = Crontab()
         self.aoi = AOIEngine(default_backend=aoi_backend)
         self.entities = EntityManager(self)
         self.tick_count = 0
@@ -64,6 +66,7 @@ class Runtime:
     def tick(self):
         self.tick_count += 1
         self.timers.tick(self.on_error)
+        self.crontab.maybe_check()
         self._aoi_phase()
         self._sync_phase()
         self.post.tick(self.on_error)

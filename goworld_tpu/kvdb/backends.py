@@ -18,6 +18,10 @@ from __future__ import annotations
 import json
 import os
 
+from ..utils import gwlog
+
+log = gwlog.logger("kvdb")
+
 
 class KVDBBackend:
     def get(self, key: str) -> str | None:
@@ -103,8 +107,8 @@ class FilesystemKVDB(KVDBBackend):
             self._log.close()
             try:
                 self._compact_if_worthwhile()
-            except OSError:
-                pass
+            except OSError as e:
+                log.warning("kvdb compaction failed (will retry later): %r", e)
             finally:
                 self._log = open(self.path, "a", encoding="utf-8")
 

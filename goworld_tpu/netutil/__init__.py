@@ -10,3 +10,4 @@ from .conn import (  # noqa: F401
 )
 from .msgpacker import JSONMsgPacker, MessagePackMsgPacker, default_packer  # noqa: F401
 from .packet import MAX_PACKET_SIZE, Packet  # noqa: F401
+from . import websocket  # noqa: F401

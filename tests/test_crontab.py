@@ -1,0 +1,125 @@
+"""Crontab semantics (reference: engine/crontab/crontab_test.go + the match
+rules in crontab.go:29-126)."""
+
+from datetime import datetime
+
+import pytest
+
+from goworld_tpu.utils.crontab import Crontab, validate
+
+
+def fire_counts(ct, dts):
+    return [ct.check_at(dt) for dt in dts]
+
+
+def test_exact_match_fields():
+    ct = Crontab()
+    hits = []
+    ct.register(30, 12, 15, 6, -1, lambda: hits.append(1))
+    assert ct.check_at(datetime(2026, 6, 15, 12, 30)) == 1
+    assert ct.check_at(datetime(2026, 6, 15, 12, 31)) == 0
+    assert ct.check_at(datetime(2026, 6, 15, 13, 30)) == 0
+    assert ct.check_at(datetime(2026, 7, 15, 12, 30)) == 0
+    assert len(hits) == 1
+
+
+def test_every_n_minutes():
+    ct = Crontab()
+    ct.register(-5, -1, -1, -1, -1, lambda: None)
+    fired = [
+        ct.check_at(datetime(2026, 1, 1, 0, m)) for m in range(12)
+    ]
+    assert fired == [1, 0, 0, 0, 0, 1, 0, 0, 0, 0, 1, 0]
+
+
+def test_every_n_hours_with_minute_zero():
+    ct = Crontab()
+    ct.register(0, -6, -1, -1, -1, lambda: None)
+    assert ct.check_at(datetime(2026, 1, 1, 0, 0)) == 1
+    assert ct.check_at(datetime(2026, 1, 1, 6, 0)) == 1
+    assert ct.check_at(datetime(2026, 1, 1, 7, 0)) == 0
+    assert ct.check_at(datetime(2026, 1, 1, 6, 1)) == 0
+
+
+def test_dayofweek_sunday_is_0_and_7():
+    # 2026-07-26 is a Sunday
+    sunday = datetime(2026, 7, 26, 9, 0)
+    monday = datetime(2026, 7, 27, 9, 0)
+    for dow in (0, 7):
+        ct = Crontab()
+        ct.register(0, 9, -1, -1, dow, lambda: None)
+        assert ct.check_at(sunday) == 1
+        assert ct.check_at(monday) == 0
+    ct = Crontab()
+    ct.register(0, 9, -1, -1, 1, lambda: None)  # Monday
+    assert ct.check_at(sunday) == 0
+    assert ct.check_at(monday) == 1
+
+
+def test_unregister_and_len():
+    ct = Crontab()
+    h = ct.register(-1, -1, -1, -1, -1, lambda: None)
+    assert len(ct) == 1
+    assert ct.unregister(h)
+    assert not ct.unregister(h)
+    assert len(ct) == 0
+    assert ct.check_at(datetime(2026, 1, 1, 0, 0)) == 0
+
+
+def test_callback_exception_isolated():
+    ct = Crontab()
+    hits = []
+    ct.register(-1, -1, -1, -1, -1, lambda: 1 / 0)
+    ct.register(-1, -1, -1, -1, -1, lambda: hits.append(1))
+    assert ct.check_at(datetime(2026, 1, 1, 0, 0)) == 2
+    assert hits == [1]
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        (60, -1, -1, -1, -1),
+        (-61, -1, -1, -1, -1),
+        (0, 24, -1, -1, -1),
+        (0, 0, 0, -1, -1),
+        (0, 0, 32, -1, -1),
+        (0, 0, 1, 0, -1),
+        (0, 0, 1, 13, -1),
+        (0, 0, 1, 1, 8),
+        (0, 0, 1, 1, -2),
+    ],
+)
+def test_validate_rejects(bad):
+    with pytest.raises(ValueError):
+        validate(*bad)
+
+
+def test_maybe_check_fires_once_per_minute():
+    clock = [120.0]
+    ct = Crontab(wallclock=lambda: clock[0])
+    hits = []
+    ct.register(-1, -1, -1, -1, -1, lambda: hits.append(1))
+    assert ct.maybe_check() == 0  # first observation never fires
+    clock[0] = 125.0
+    assert ct.maybe_check() == 0  # same minute
+    clock[0] = 180.0
+    assert ct.maybe_check() == 1  # minute boundary crossed
+    clock[0] = 181.0
+    assert ct.maybe_check() == 0
+    clock[0] = 241.0
+    assert ct.maybe_check() == 1
+    assert len(hits) == 2
+
+
+def test_runtime_wires_crontab():
+    from goworld_tpu.engine.runtime import Runtime
+
+    rt = Runtime()
+    clock = [0.0]
+    rt.crontab._wallclock = lambda: clock[0]
+    hits = []
+    rt.crontab.register(-1, -1, -1, -1, -1, lambda: hits.append(1))
+    rt.tick()
+    clock[0] = 60.0
+    rt.tick()
+    assert hits == [1]

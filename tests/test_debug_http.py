@@ -1,0 +1,60 @@
+"""gwvar + debug HTTP server (reference: engine/gwvar expvar flags and
+binutil's pprof HTTP surface)."""
+
+import json
+import urllib.request
+
+from goworld_tpu.utils import binutil, gwvar, opmon
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read()
+
+
+def test_gwvar_roundtrip():
+    gwvar.reset()
+    gwvar.set_var("is_deployment_ready", False)
+    gwvar.set_var("is_deployment_ready", True)
+    gwvar.add("packets", 3)
+    gwvar.add("packets")
+    snap = gwvar.snapshot()
+    assert snap["is_deployment_ready"] is True
+    assert snap["packets"] == 4
+    assert gwvar.get_var("missing", 7) == 7
+
+
+def test_debug_http_endpoints():
+    gwvar.reset()
+    gwvar.set_var("component", "test")
+    op = opmon.start_operation("unit_test_op")
+    op.finish()
+
+    srv = binutil.setup_http_server(0)
+    try:
+        port = srv.server_address[1]
+
+        status, body = _get(port, "/debug/vars")
+        assert status == 200
+        vars_ = json.loads(body)
+        assert vars_["component"] == "test"
+        assert vars_["debug_http_addr"].endswith(str(port))
+
+        status, body = _get(port, "/debug/opmon")
+        assert status == 200
+        assert "unit_test_op" in json.loads(body)
+
+        status, body = _get(port, "/debug/stacks")
+        assert status == 200
+        assert b"--- thread" in body
+
+        status, body = _get(port, "/debug/health")
+        assert (status, body) == (200, b"ok")
+
+        try:
+            _get(port, "/nope")
+            raise AssertionError("404 expected")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        srv.shutdown()

@@ -1,0 +1,266 @@
+"""WebSocket + TLS transports (reference: gate TCP/KCP/WebSocket listeners
+with optional TLS, GateService.go:84-118, gate.go:92-95).
+
+Unit level: RFC6455 framing round-trip over a socketpair.
+Integration level: an in-process cluster with a websocket listener and a
+TLS gate; the stock client SDK connects through each and plays.
+"""
+
+import socket
+import subprocess
+import threading
+
+import pytest
+
+from goworld_tpu.netutil import websocket
+from goworld_tpu.netutil.conn import PacketConnection
+from goworld_tpu.netutil.packet import Packet
+
+
+def test_ws_frame_roundtrip_masked_and_unmasked():
+    a, b = socket.socketpair()
+    try:
+        client = websocket.WSSocket(a, mask_outgoing=True)
+        server = websocket.WSSocket(b, mask_outgoing=False)
+        client.sendall(b"hello world")
+        assert server.recv() == b"hello world"
+        server.sendall(b"x" * 70000)  # 64-bit length header path
+        assert client.recv() == b"x" * 70000
+        server.sendall(b"y" * 1000)  # 16-bit length header path
+        assert client.recv() == b"y" * 1000
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ws_ping_is_answered_and_close_returns_empty():
+    a, b = socket.socketpair()
+    try:
+        server = websocket.WSSocket(b, mask_outgoing=False)
+        # raw ping from the "client"
+        a.sendall(websocket._encode_frame(websocket.OP_PING, b"p", True))
+        a.sendall(websocket._encode_frame(websocket.OP_BINARY, b"data", True))
+        assert server.recv() == b"data"  # ping consumed transparently
+        # the pong came back
+        got = a.recv(64)
+        assert got[0] & 0x0F == websocket.OP_PONG
+        a.sendall(websocket._encode_frame(websocket.OP_CLOSE, b"", True))
+        assert server.recv() == b""
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ws_handshake_and_packet_connection():
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(1)
+    port = ls.getsockname()[1]
+    got = []
+
+    def server():
+        s, _ = ls.accept()
+        headers, residue = websocket.server_handshake(s)
+        got.append(headers)
+        pc = PacketConnection(
+            websocket.WSSocket(s, mask_outgoing=False, residue=residue)
+        )
+        pkt = pc.recv_packet()
+        echo = Packet(bytearray(pkt.payload))
+        pc.send_packet(echo)
+        pc.flush()
+        s.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+
+    cs = socket.create_connection(("127.0.0.1", port))
+    websocket.client_handshake(cs, f"127.0.0.1:{port}")
+    pc = PacketConnection(websocket.WSSocket(cs, mask_outgoing=True))
+    out = Packet()
+    out.append_u16(4242)
+    out.append_varstr("over websocket")
+    pc.send_packet(out)
+    pc.flush()
+    back = pc.recv_packet()
+    assert back.read_u16() == 4242
+    assert back.read_varstr() == "over websocket"
+    assert got and "sec-websocket-key" in got[0]
+    cs.close()
+    ls.close()
+
+
+def test_ws_residue_after_handshake_not_lost():
+    """A frame pipelined in the same segment as the handshake must be
+    delivered (handshake returns residue which seeds the WSSocket)."""
+    a, b = socket.socketpair()
+    try:
+        key = "dGhlIHNhbXBsZSBub25jZQ=="
+        frame = websocket._encode_frame(websocket.OP_BINARY, b"pipelined", True)
+        a.sendall(
+            (
+                "GET /ws HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+                f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n\r\n"
+            ).encode()
+            + frame
+        )
+        headers, residue = websocket.server_handshake(b)
+        ws = websocket.WSSocket(b, mask_outgoing=False, residue=residue)
+        assert ws.recv() == b"pipelined"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ws_mid_frame_timeout_keeps_stream_position():
+    """A short recv timeout striking mid-frame must not desync parsing."""
+    a, b = socket.socketpair()
+    try:
+        ws = websocket.WSSocket(b, mask_outgoing=False)
+        ws.settimeout(0.05)
+        frame = websocket._encode_frame(
+            websocket.OP_BINARY, b"z" * 300, True
+        )  # 16-bit extended length header
+        a.sendall(frame[:3])  # header split mid-extended-length
+        with pytest.raises(TimeoutError):
+            ws.recv()
+        a.sendall(frame[3:])
+        assert ws.recv() == b"z" * 300
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ws_oversized_frame_rejected():
+    a, b = socket.socketpair()
+    try:
+        ws = websocket.WSSocket(b, mask_outgoing=False)
+        # header declaring a 1 GiB frame
+        hdr = bytes([0x82, 127]) + (1 << 30).to_bytes(8, "big")
+        a.sendall(hdr)
+        assert ws.recv() == b""  # treated as closed, nothing buffered
+    finally:
+        a.close()
+        b.close()
+
+
+def test_ws_rejects_plain_http():
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(1)
+    port = ls.getsockname()[1]
+    errs = []
+
+    def server():
+        s, _ = ls.accept()
+        try:
+            websocket.server_handshake(s)
+        except ValueError as e:
+            errs.append(e)
+        s.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    cs = socket.create_connection(("127.0.0.1", port))
+    cs.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+    reply = cs.recv(256)
+    t.join(5)
+    assert b"400" in reply
+    assert errs
+    cs.close()
+    ls.close()
+
+
+# -- integration through a live gate --------------------------------------
+
+from goworld_tpu.engine.entity import Entity
+from goworld_tpu.engine.rpc import OWN_CLIENT, rpc
+
+
+class TransportAvatar(Entity):
+    all_client_attrs = frozenset({"name"})
+
+    @rpc(expose=OWN_CLIENT)
+    def set_name(self, name):
+        self.attrs.set("name", name)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    from goworld_tpu import config
+    from goworld_tpu.components.dispatcher.service import DispatcherService
+    from goworld_tpu.components.game.service import GameService
+    from goworld_tpu.components.gate.service import GateService
+
+    cert, key = str(tmp_path / "t.crt"), str(tmp_path / "t.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        check=True, capture_output=True,
+    )
+    cfg = config.loads(
+        f"""
+[deployment]
+dispatchers = 1
+games = 1
+gates = 2
+
+[dispatcher1]
+port = 0
+
+[game_common]
+boot_entity = TransportAvatar
+aoi_backend = cpu
+position_sync_interval_ms = 20
+
+[gate1]
+port = 0
+websocket_port = -1
+
+[gate2]
+port = 0
+tls_cert = {cert}
+tls_key = {key}
+"""
+    )
+    disp = DispatcherService(1, cfg).start()
+    cfg.dispatchers[1].host, cfg.dispatchers[1].port = disp.addr
+
+    game = GameService(1, cfg)
+    game.register_entity_type(TransportAvatar)
+    game.start()
+    g1 = GateService(1, cfg).start()
+    g2 = GateService(2, cfg).start()
+    try:
+        yield disp, game, g1, g2
+    finally:
+        for svc in (g1, g2, game, disp):
+            try:
+                svc.stop()
+            except Exception:
+                pass
+
+
+def test_client_over_websocket_and_tls(cluster):
+    from goworld_tpu.client import GameClientConnection
+
+    _, _, g1, g2 = cluster
+    assert g1.ws_addr is not None
+
+    ws = GameClientConnection(g1.ws_addr, transport="ws")
+    assert ws.wait_for(lambda c: c.player is not None, 15), "ws boot"
+    ws.call_player("set_name", "wsbot")
+    assert ws.wait_for(
+        lambda c: c.player.attrs.get("name") == "wsbot", 15
+    ), "ws attr mirror"
+    ws.send_position(10.0, 0.0, 20.0)
+    ws.close()
+
+    tls = GameClientConnection(g2.addr, tls=True)
+    assert tls.wait_for(lambda c: c.player is not None, 15), "tls boot"
+    tls.call_player("set_name", "tlsbot")
+    assert tls.wait_for(
+        lambda c: c.player.attrs.get("name") == "tlsbot", 15
+    ), "tls attr mirror"
+    tls.close()
