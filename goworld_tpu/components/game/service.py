@@ -77,20 +77,22 @@ class GameService:
         """Create the async entity-storage service from config (reference:
         storage.Initialize, game.go:100)."""
         from ...storage import EntityStorageService, new_entity_storage
+        from ...storage.backends import config_kwargs
 
         backend = new_entity_storage(
             self.cfg.storage.backend,
-            directory=os.path.join(base_dir, self.cfg.storage.directory),
+            **config_kwargs(self.cfg.storage.backend, self.cfg.storage, base_dir),
         )
         self.storage = EntityStorageService(backend, post=self.rt.post.post)
         return self.storage
 
     def attach_kvdb(self, base_dir: str = "."):
         from ...kvdb import KVDBService, new_kvdb_backend
+        from ...kvdb.backends import config_kwargs
 
         backend = new_kvdb_backend(
             self.cfg.kvdb.backend,
-            directory=os.path.join(base_dir, self.cfg.kvdb.directory),
+            **config_kwargs(self.cfg.kvdb.backend, self.cfg.kvdb, base_dir),
         )
         self.kvdb = KVDBService(backend, post=self.rt.post.post)
         return self.kvdb

@@ -70,14 +70,20 @@ class GateConfig:
 
 @dataclass
 class StorageConfig:
-    backend: str = "filesystem"
-    directory: str = "entity_storage"
+    backend: str = "filesystem"  # filesystem | sqlite | redis
+    directory: str = "entity_storage"  # directory-kind backends
+    host: str = "127.0.0.1"  # server-kind backends (redis)
+    port: int = 6379
+    db: int = 0
 
 
 @dataclass
 class KVDBConfig:
-    backend: str = "filesystem"
+    backend: str = "filesystem"  # filesystem | sqlite | redis
     directory: str = "kvdb"
+    host: str = "127.0.0.1"
+    port: int = 6379
+    db: int = 0
 
 
 @dataclass

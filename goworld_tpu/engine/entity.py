@@ -310,6 +310,46 @@ class Entity:
             self.space.leave_entity(self)
         sp.enter_entity(self, pos)
 
+    # -- cluster conveniences ----------------------------------------------
+    @property
+    def game(self):
+        """The hosting GameService when clustered, else None."""
+        return getattr(self._runtime(), "game", None)
+
+    @property
+    def kvdb(self):
+        """The game's KVDB service (None when not attached)."""
+        game = self.game
+        return getattr(game, "kvdb", None) if game is not None else None
+
+    def call_entity(self, eid: str, method: str, *args):
+        """Call a method on another entity by id (reference: goworld.Call /
+        EntityManager.Call).  Clustered: the game routes (local fast path or
+        dispatcher fabric); unclustered: local post only."""
+        game = self.game
+        if game is not None:
+            game.call_entity(eid, method, *args)
+            return
+        local = self.manager.entities.get(eid)
+        if local is None:
+            raise KeyError(f"no local entity {eid} (not clustered)")
+        self._runtime().post.post(lambda: local.call(method, *args))
+
+    def set_filter_prop(self, key: str, value: str):
+        """Set a gate-side filter property on this entity's client
+        (reference: Entity.SetFilterProp, Entity.go:1136-1150)."""
+        game = self.game
+        if game is not None and self.client is not None:
+            game.set_client_filter_prop(self, key, value)
+
+    def call_filtered_clients(self, key: str, op: int, value: str,
+                              method: str, *args):
+        """Broadcast an RPC to every client whose filter props match
+        (reference: Entity.CallFilteredClients, Entity.go:1150-1170)."""
+        game = self.game
+        if game is not None:
+            game.call_filtered_clients(key, op, value, method, *args)
+
     # -- client calls ------------------------------------------------------
     def call_client(self, method: str, *args):
         if self.client is not None:

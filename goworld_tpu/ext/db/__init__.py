@@ -1,0 +1,5 @@
+"""Direct DB access helpers for game code (reference role: ext/db --
+gwmongo/gwredis async wrappers).  Here: a pure-python RESP (redis protocol)
+client, an in-process mini-redis server for hermetic development/testing,
+and async wrappers (gwredis / gwsql) whose callbacks re-enter the logic
+thread via post, matching the reference's ext/db callback contract."""

@@ -120,7 +120,109 @@ class FilesystemKVDB(KVDBBackend):
         self._log.close()
 
 
-_REGISTRY = {"filesystem": FilesystemKVDB}
+class SqliteKVDB(KVDBBackend):
+    """SQL-family kvdb (reference role: kvdb/backend/kvdb_mysql).  One
+    ``kv(k, v)`` table; range find is an indexed scan."""
+
+    def __init__(self, directory: str):
+        import sqlite3
+
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "kvdb.sqlite")
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS kv"
+            " (k TEXT PRIMARY KEY, v TEXT NOT NULL)"
+        )
+        self._db.commit()
+
+    def get(self, key: str) -> str | None:
+        row = self._db.execute(
+            "SELECT v FROM kv WHERE k = ?", (key,)
+        ).fetchone()
+        return None if row is None else row[0]
+
+    def put(self, key: str, val: str) -> None:
+        self._db.execute(
+            "INSERT INTO kv (k, v) VALUES (?, ?)"
+            " ON CONFLICT (k) DO UPDATE SET v = excluded.v",
+            (key, val),
+        )
+        self._db.commit()
+
+    def find(self, begin: str, end: str) -> list[tuple[str, str]]:
+        rows = self._db.execute(
+            "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+            (begin, end),
+        ).fetchall()
+        return [(k, v) for k, v in rows]
+
+    def close(self) -> None:
+        self._db.close()
+
+
+class RedisKVDB(KVDBBackend):
+    """Redis kvdb (reference: kvdb/backend/kvdb_redis).  Values live at
+    ``kvdb:<key>``; a sorted set mirrors the key space so ``find`` is an
+    ordered lex range instead of a KEYS scan.  ``get_or_put`` uses SETNX
+    for native compare-and-set."""
+
+    config_kind = "server"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 db: int = 0):
+        from ..ext.db.resp import RespClient
+
+        self._c = RespClient(host, port, db=db)
+
+    @staticmethod
+    def _key(key: str) -> str:
+        return f"kvdb:{key}"
+
+    _INDEX = "kvdb-index"
+
+    def get(self, key: str) -> str | None:
+        v = self._c.command("GET", self._key(key))
+        return None if v is None else v.decode("utf-8")
+
+    def put(self, key: str, val: str) -> None:
+        # index first: a crash between the two commands then self-heals
+        # (find() filters keys whose value is missing), whereas value-first
+        # would leave a value invisible to find() forever
+        self._c.command("ZADD", self._INDEX, 0, key)
+        self._c.command("SET", self._key(key), val)
+
+    def get_or_put(self, key: str, val: str) -> str | None:
+        if self._c.command("SETNX", self._key(key), val):
+            self._c.command("ZADD", self._INDEX, 0, key)
+            return None
+        v = self._c.command("GET", self._key(key))
+        return None if v is None else v.decode("utf-8")
+
+    def find(self, begin: str, end: str) -> list[tuple[str, str]]:
+        if end == "":
+            return []  # half-open [begin, "") is empty
+        lo = "-" if begin == "" else f"[{begin}"
+        members = self._c.command("ZRANGEBYLEX", self._INDEX, lo, f"({end}")
+        if not members:
+            return []
+        keys = [m.decode("utf-8") for m in members]
+        vals = self._c.command("MGET", *[self._key(k) for k in keys])
+        return [
+            (k, v.decode("utf-8"))
+            for k, v in zip(keys, vals)
+            if v is not None
+        ]
+
+    def close(self) -> None:
+        self._c.close()
+
+
+_REGISTRY = {
+    "filesystem": FilesystemKVDB,
+    "sqlite": SqliteKVDB,
+    "redis": RedisKVDB,
+}
 
 
 def register_backend(name: str, cls):
@@ -134,3 +236,17 @@ def new_kvdb_backend(backend: str, **kwargs) -> KVDBBackend:
             f"unknown kvdb backend {backend!r} (have {sorted(_REGISTRY)})"
         )
     return cls(**kwargs)
+
+
+def config_kwargs(backend: str, cfg, base_dir: str = ".") -> dict:
+    """Constructor kwargs for a backend from its config section; the class
+    attribute ``config_kind`` ("server" vs default "directory") selects the
+    keys, so registered custom backends compose (see storage.backends)."""
+    cls = _REGISTRY.get(backend)
+    if cls is None:
+        raise ValueError(
+            f"unknown kvdb backend {backend!r} (have {sorted(_REGISTRY)})"
+        )
+    if getattr(cls, "config_kind", "directory") == "server":
+        return {"host": cfg.host, "port": cfg.port, "db": cfg.db}
+    return {"directory": os.path.join(base_dir, cfg.directory)}

@@ -5,16 +5,22 @@ The backend interface (reference: storage_common/storage_common.go:6-13):
 ``exists(type, eid) -> bool``, ``list_entity_ids(type) -> list[str]``,
 ``close()``.  Backends are synchronous; the service wraps them in the worker.
 
-``filesystem`` stores one msgpack file per entity under
-``<dir>/<type>/<eid>`` (hermetic -- the test backend, like the reference's
-filesystem backend).  DB-backed backends (redis/mongo/mysql in the
-reference) plug in behind the same interface; none are shipped because this
-image has no database services -- the interface + registry are the seam.
+Shipped backends (reference set: filesystem/mongodb/redis/redis_cluster/
+mysql, storage/backend/*):
+
+  * ``filesystem`` -- one msgpack file per entity under ``<dir>/<type>/<eid>``
+    (hermetic; mirrors the reference's filesystem backend);
+  * ``sqlite``     -- the SQL-family backend (reference: mysql), stdlib
+    sqlite3, one ``entities(type, eid, data)`` table;
+  * ``redis``      -- RESP protocol via ext/db/resp; keys
+    ``storage:<type>:<eid>`` holding msgpack blobs, tested hermetically
+    against ext/db/miniredis.
 """
 
 from __future__ import annotations
 
 import os
+import sqlite3
 
 import msgpack
 
@@ -72,7 +78,111 @@ class FilesystemEntityStorage(EntityStorageBackend):
             return []
 
 
-_REGISTRY = {"filesystem": FilesystemEntityStorage}
+class SqliteEntityStorage(EntityStorageBackend):
+    """SQL-family backend (reference role: backend/mysql).  One connection;
+    safe because the storage service serializes all ops on one ordered
+    worker thread."""
+
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "entities.sqlite")
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS entities ("
+            " type TEXT NOT NULL, eid TEXT NOT NULL, data BLOB NOT NULL,"
+            " PRIMARY KEY (type, eid))"
+        )
+        self._db.commit()
+
+    def write(self, type_name: str, eid: str, data: dict) -> None:
+        blob = msgpack.packb(data, use_bin_type=True)
+        self._db.execute(
+            "INSERT INTO entities (type, eid, data) VALUES (?, ?, ?)"
+            " ON CONFLICT (type, eid) DO UPDATE SET data = excluded.data",
+            (type_name, eid, blob),
+        )
+        self._db.commit()
+
+    def read(self, type_name: str, eid: str) -> dict | None:
+        row = self._db.execute(
+            "SELECT data FROM entities WHERE type = ? AND eid = ?",
+            (type_name, eid),
+        ).fetchone()
+        if row is None:
+            return None
+        return msgpack.unpackb(row[0], raw=False)
+
+    def exists(self, type_name: str, eid: str) -> bool:
+        row = self._db.execute(
+            "SELECT 1 FROM entities WHERE type = ? AND eid = ?",
+            (type_name, eid),
+        ).fetchone()
+        return row is not None
+
+    def list_entity_ids(self, type_name: str) -> list[str]:
+        rows = self._db.execute(
+            "SELECT eid FROM entities WHERE type = ? ORDER BY eid",
+            (type_name,),
+        ).fetchall()
+        return [r[0] for r in rows]
+
+    def close(self) -> None:
+        self._db.close()
+
+
+class RedisEntityStorage(EntityStorageBackend):
+    """Redis backend (reference: backend/redis/entity_storage_redis.go).
+    ``storage:<type>:<eid>`` -> msgpack blob; a per-type set-index is kept
+    in a sorted set for list_entity_ids (KEYS-free listing)."""
+
+    config_kind = "server"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 db: int = 0):
+        from ..ext.db.resp import RespClient
+
+        self._c = RespClient(host, port, db=db)
+
+    @staticmethod
+    def _key(type_name: str, eid: str) -> str:
+        return f"storage:{type_name}:{eid}"
+
+    @staticmethod
+    def _index(type_name: str) -> str:
+        return f"storage-index:{type_name}"
+
+    def write(self, type_name: str, eid: str, data: dict) -> None:
+        blob = msgpack.packb(data, use_bin_type=True)
+        # index first (see RedisKVDB.put): a torn write leaves a listed eid
+        # whose read() returns None, which callers already handle, rather
+        # than a stored entity invisible to list_entity_ids forever
+        self._c.command("ZADD", self._index(type_name), 0, eid)
+        self._c.command("SET", self._key(type_name, eid), blob)
+
+    def read(self, type_name: str, eid: str) -> dict | None:
+        blob = self._c.command("GET", self._key(type_name, eid))
+        if blob is None:
+            return None
+        return msgpack.unpackb(blob, raw=False)
+
+    def exists(self, type_name: str, eid: str) -> bool:
+        return bool(self._c.command("EXISTS", self._key(type_name, eid)))
+
+    def list_entity_ids(self, type_name: str) -> list[str]:
+        members = self._c.command(
+            "ZRANGEBYLEX", self._index(type_name), "-", "+"
+        )
+        return [m.decode("utf-8") for m in members or []]
+
+    def close(self) -> None:
+        self._c.close()
+
+
+_REGISTRY = {
+    "filesystem": FilesystemEntityStorage,
+    "sqlite": SqliteEntityStorage,
+    "redis": RedisEntityStorage,
+}
 
 
 def register_backend(name: str, cls):
@@ -86,3 +196,18 @@ def new_entity_storage(backend: str, **kwargs) -> EntityStorageBackend:
             f"unknown storage backend {backend!r} (have {sorted(_REGISTRY)})"
         )
     return cls(**kwargs)
+
+
+def config_kwargs(backend: str, cfg, base_dir: str = ".") -> dict:
+    """Constructor kwargs for a backend from its config section.  The
+    backend class declares its kind via ``config_kind``: "server" consumes
+    host/port/db; the default ("directory") consumes directory -- so
+    backends added through register_backend pick their own keys."""
+    cls = _REGISTRY.get(backend)
+    if cls is None:
+        raise ValueError(
+            f"unknown storage backend {backend!r} (have {sorted(_REGISTRY)})"
+        )
+    if getattr(cls, "config_kind", "directory") == "server":
+        return {"host": cfg.host, "port": cfg.port, "db": cfg.db}
+    return {"directory": os.path.join(base_dir, cfg.directory)}

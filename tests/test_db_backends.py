@@ -1,0 +1,201 @@
+"""sqlite + redis storage/kvdb backends and ext/db wrappers (reference:
+storage/backend/{mysql,redis}, kvdb/backend/*, ext/db/gwredis -- the
+reference tests these against live CI databases, .travis.yml:27-35; here
+redis is the in-process wire-compatible miniredis, sqlite is stdlib)."""
+
+import pytest
+
+from goworld_tpu.ext.db.miniredis import MiniRedis
+from goworld_tpu.ext.db.resp import RespClient, RespError
+from goworld_tpu.kvdb.backends import new_kvdb_backend
+from goworld_tpu.storage.backends import new_entity_storage
+
+
+@pytest.fixture(scope="module")
+def redis_server():
+    srv = MiniRedis()
+    yield srv
+    srv.close()
+
+
+# -- RESP layer ------------------------------------------------------------
+
+def test_resp_roundtrip(redis_server):
+    c = RespClient(*redis_server.addr)
+    assert c.command("PING") == "PONG"
+    assert c.command("SET", "a", "1") == "OK"
+    assert c.command("GET", "a") == b"1"
+    assert c.command("GET", "missing") is None
+    assert c.command("EXISTS", "a") == 1
+    assert c.command("DEL", "a") == 1
+    with pytest.raises(RespError):
+        c.command("NOSUCHCMD")
+    c.close()
+
+
+def test_resp_binary_safe(redis_server):
+    c = RespClient(*redis_server.addr)
+    blob = bytes(range(256)) * 10
+    c.command("SET", "bin", blob)
+    assert c.command("GET", "bin") == blob
+    c.close()
+
+
+# -- entity storage backends ------------------------------------------------
+
+def _exercise_entity_storage(be):
+    assert be.read("Avatar", "e1") is None
+    assert not be.exists("Avatar", "e1")
+    data = {"name": "bob", "lv": 3, "inv": [1, 2, {"id": "sword"}]}
+    be.write("Avatar", "e1", data)
+    be.write("Avatar", "e2", {"name": "alice"})
+    be.write("Monster", "m1", {"hp": 50})
+    assert be.read("Avatar", "e1") == data
+    assert be.exists("Avatar", "e1")
+    assert be.list_entity_ids("Avatar") == ["e1", "e2"]
+    assert be.list_entity_ids("Monster") == ["m1"]
+    assert be.list_entity_ids("Nothing") == []
+    be.write("Avatar", "e1", {"name": "bob2"})  # overwrite
+    assert be.read("Avatar", "e1") == {"name": "bob2"}
+    be.close()
+
+
+def test_sqlite_entity_storage(tmp_path):
+    be = new_entity_storage("sqlite", directory=str(tmp_path))
+    _exercise_entity_storage(be)
+    # persists across reopen
+    be2 = new_entity_storage("sqlite", directory=str(tmp_path))
+    assert be2.read("Avatar", "e2") == {"name": "alice"}
+    be2.close()
+
+
+def test_redis_entity_storage(redis_server):
+    host, port = redis_server.addr
+    be = new_entity_storage("redis", host=host, port=port, db=1)
+    _exercise_entity_storage(be)
+
+
+# -- kvdb backends ----------------------------------------------------------
+
+def _exercise_kvdb(be):
+    assert be.get("k") is None
+    be.put("k", "v")
+    assert be.get("k") == "v"
+    be.put("k", "v2")
+    assert be.get("k") == "v2"
+    assert be.get_or_put("k", "other") == "v2"
+    assert be.get_or_put("fresh", "first") is None
+    assert be.get("fresh") == "first"
+    for k in ("b", "a", "c", "ab"):
+        be.put(k, k.upper())
+    assert be.find("a", "c") == [("a", "A"), ("ab", "AB"), ("b", "B")]
+    assert be.find("", "") == []
+    be.close()
+
+
+def test_sqlite_kvdb(tmp_path):
+    be = new_kvdb_backend("sqlite", directory=str(tmp_path))
+    _exercise_kvdb(be)
+    be2 = new_kvdb_backend("sqlite", directory=str(tmp_path))
+    assert be2.get("fresh") == "first"
+    be2.close()
+
+
+def test_redis_kvdb(redis_server):
+    host, port = redis_server.addr
+    be = new_kvdb_backend("redis", host=host, port=port, db=2)
+    _exercise_kvdb(be)
+
+
+# -- ext/db async wrappers ---------------------------------------------------
+
+def test_gwredis_async(redis_server):
+    from goworld_tpu.ext.db.gwredis import GWRedis
+
+    host, port = redis_server.addr
+    posted = []
+    r = GWRedis(host, port, db=3, post=lambda fn: posted.append(fn))
+    results = []
+    r.set("x", "42")
+    r.get("x", callback=lambda v: results.append(v))
+    assert r._worker.wait_clear(5)
+    for fn in posted:
+        fn()  # drain the "logic thread"
+    assert results == [b"42"]
+    r.close()
+
+
+def test_gwsql_async(tmp_path):
+    from goworld_tpu.ext.db.gwsql import GWSql, JobError
+
+    db = GWSql(str(tmp_path / "g.sqlite"))
+    results = []
+    db.execute("CREATE TABLE t (a INTEGER)")
+    db.execute("INSERT INTO t VALUES (1), (2)", callback=results.append)
+    db.query("SELECT a FROM t ORDER BY a", callback=results.append)
+    db.query("SELECT broken syntax", callback=results.append)
+    assert db._worker.wait_clear(5)
+    assert results[0] == 2
+    assert results[1] == [(1,), (2,)]
+    assert isinstance(results[2], JobError)
+    db.close()
+
+
+# -- through the game service ------------------------------------------------
+
+def test_game_service_with_redis_storage(redis_server, tmp_path):
+    """A game configured with backend=redis persists avatars through the
+    miniredis server (reference analog: CI running the cluster against live
+    redis)."""
+    from goworld_tpu import config
+    from goworld_tpu.components.game.service import GameService
+
+    host, port = redis_server.addr
+    cfg = config.loads(
+        f"""
+[deployment]
+dispatchers = 1
+games = 1
+gates = 0
+
+[dispatcher1]
+port = 1
+
+[game_common]
+aoi_backend = cpu
+
+[storage]
+backend = redis
+host = {host}
+port = {port}
+db = 4
+
+[kvdb]
+backend = redis
+host = {host}
+port = {port}
+db = 5
+"""
+    )
+    gs = GameService(1, cfg)  # not started: storage/kvdb only
+    storage = gs.attach_storage()
+    kv = gs.attach_kvdb()
+
+    done = []
+    storage.save("Avatar", "av1", {"name": "redisbob"}, callback=lambda: done.append(1))
+    storage._worker.wait_clear(5)
+    gs.rt.post.tick(lambda e: None)
+    assert done == [1]
+
+    loaded = []
+    storage.load("Avatar", "av1", callback=loaded.append)
+    storage._worker.wait_clear(5)
+    gs.rt.post.tick(lambda e: None)
+    assert loaded == [{"name": "redisbob"}]
+
+    got = []
+    kv.put("name-index:redisbob", "av1", callback=lambda _: got.append(1))
+    kv.get("name-index:redisbob", callback=got.append)
+    kv._worker.wait_clear(5)
+    gs.rt.post.tick(lambda e: None)
+    assert got == [1, "av1"]

@@ -1,0 +1,251 @@
+"""The chatroom_demo and test_game examples as e2e tests (reference:
+examples double as integration tests / API spec -- SURVEY.md:2.10)."""
+
+import importlib.util
+import os
+import sys
+import time
+
+import pytest
+
+from goworld_tpu import config as gwconfig
+from goworld_tpu.client import GameClientConnection
+from goworld_tpu.components.dispatcher.service import DispatcherService
+from goworld_tpu.components.game.service import GameService
+from goworld_tpu.components.gate.service import GateService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_example(name):
+    path = os.path.join(REPO, "examples", name, "server.py")
+    spec = importlib.util.spec_from_file_location(f"example_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[f"example_{name}"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def make_cluster(tmp_path, mod, boot_entity, games=1):
+    cfg = gwconfig.loads(
+        f"""
+[deployment]
+dispatchers = 1
+games = {games}
+gates = 1
+
+[dispatcher1]
+port = 0
+
+[game_common]
+boot_entity = {boot_entity}
+aoi_backend = cpu
+position_sync_interval_ms = 20
+
+[gate1]
+port = 0
+
+[storage]
+directory = {tmp_path}/entity_storage
+
+[kvdb]
+directory = {tmp_path}/kvdb
+"""
+    )
+    disp = DispatcherService(1, cfg).start()
+    cfg.dispatchers[1].host, cfg.dispatchers[1].port = disp.addr
+    game_svcs = []
+    for gid in range(1, games + 1):
+        gs = GameService(gid, cfg, freeze_dir=str(tmp_path))
+        gs.attach_storage(str(tmp_path))
+        gs.attach_kvdb(str(tmp_path))
+        mod.setup(gs)
+        gs.start()
+        game_svcs.append(gs)
+    gate = GateService(1, cfg).start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not all(
+        g.deployment_ready for g in game_svcs
+    ):
+        time.sleep(0.01)
+    assert all(g.deployment_ready for g in game_svcs)
+    if hasattr(mod, "on_ready"):
+        for gs in game_svcs:
+            gs.rt.post.post(lambda gs=gs: mod.on_ready(gs))
+    return disp, game_svcs, gate
+
+
+def teardown_cluster(disp, games, gate):
+    gate.stop()
+    for g in games:
+        g.stop()
+    disp.stop()
+
+
+def wait_reply(c, send, pred, timeout=10.0):
+    """Re-issue an idempotent request until its reply arrives (cluster
+    singletons are placed by periodic reconciliation, so early requests can
+    race service discovery)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        send()
+        if c.wait_for(pred, 1.0):
+            return True
+    return False
+
+
+def _calls(c, method):
+    out = []
+    for e in c.entities.values():
+        for m, args in e.calls:
+            if m == method:
+                out.append(args)
+    # filtered-client broadcasts arrive as connection-level calls
+    for m, args in c.filtered_calls:
+        if m == method:
+            out.append(args)
+    return out
+
+
+def test_chatroom_demo(tmp_path):
+    mod = load_example("chatroom_demo")
+    disp, games, gate = make_cluster(tmp_path, mod, "Account")
+    try:
+        # register
+        c1 = GameClientConnection(gate.addr)
+        assert c1.wait_for(lambda c: c.player is not None, 10)
+        c1.call_player("register", "alice", "pw1")
+        assert c1.wait_for(lambda c: _calls(c, "show_info"), 10), "no register ack"
+        assert "registered" in _calls(c1, "show_info")[0][0]
+
+        # duplicate register rejected
+        c1.call_player("register", "alice", "pw1")
+        assert c1.wait_for(lambda c: _calls(c, "show_error"), 10)
+        assert "exists" in _calls(c1, "show_error")[0][0]
+
+        # wrong password
+        c1.call_player("login", "alice", "nope")
+        assert c1.wait_for(
+            lambda c: any("password" in a[0] for a in _calls(c, "show_error")), 10
+        )
+
+        # successful login hands the client to the Avatar
+        c1.call_player("login", "alice", "pw1")
+        assert c1.wait_for(
+            lambda c: c.player is not None
+            and c.player.type_name == "Avatar"
+            and c.player.attrs.get("name") == "alice",
+            10,
+        ), "client was not handed to the avatar"
+
+        # chat within the room via filtered broadcast
+        c2 = GameClientConnection(gate.addr)
+        assert c2.wait_for(lambda c: c.player is not None, 10)
+        c2.call_player("register", "bob", "pw2")
+        assert c2.wait_for(lambda c: _calls(c, "show_info"), 10)
+        c2.call_player("login", "bob", "pw2")
+        assert c2.wait_for(
+            lambda c: c.player is not None and c.player.type_name == "Avatar", 10
+        )
+
+        c1.call_player("say", "hello room")
+        assert c1.wait_for(
+            lambda c: ("alice", "hello room") in _calls(c, "hear"), 10
+        ), "speaker did not hear own message"
+        assert c2.wait_for(
+            lambda c: ("alice", "hello room") in _calls(c2, "hear"), 10
+        ), "roommate did not hear"
+
+        # bob moves to another room; alice's messages no longer reach him
+        c2.call_player("enter_room", "private")
+        assert c2.wait_for(
+            lambda c: any("private" in a[0] for a in _calls(c, "show_info")), 10
+        )
+        n_before = len(_calls(c2, "hear"))
+        c1.call_player("say", "second")
+        assert c1.wait_for(
+            lambda c: ("alice", "second") in _calls(c, "hear"), 10
+        )
+        c2.poll(1.0)
+        assert len(_calls(c2, "hear")) == n_before, "filtered call leaked across rooms"
+
+        c1.close()
+        c2.close()
+    finally:
+        teardown_cluster(disp, games, gate)
+
+
+def test_test_game(tmp_path):
+    mod = load_example("test_game")
+    disp, games, gate = make_cluster(tmp_path, mod, "Avatar", games=2)
+    try:
+        c1 = GameClientConnection(gate.addr)
+        c2 = GameClientConnection(gate.addr)
+        for c, name in ((c1, "p1"), (c2, "p2")):
+            assert c.wait_for(lambda c: c.player is not None, 10)
+            c.call_player("set_name", name)
+            assert c.wait_for(
+                lambda c: c.player.attrs.get("name") == name, 10
+            )
+            c.call_player("join_scene")
+
+        # both in the scene: AOI makes them visible to each other
+        assert c1.wait_for(
+            lambda c: any(
+                e.type_name == "Avatar" and not e.is_player
+                for e in c.entities.values()
+            ),
+            10,
+        ), "neighbor avatar never appeared via AOI"
+
+        # wait until both avatars checked in (retried server-side), then
+        # query the online service
+        both = {c1.player.id, c2.player.id}
+        assert wait_reply(
+            c1, lambda: c1.call_player("who_is_online"),
+            lambda c: any(both <= set(a[0]) for a in _calls(c, "online_list")),
+            timeout=15.0,
+        ), "online list never contained both avatars"
+
+        # pubsub broadcast (resent until the subscription + service exist)
+        assert wait_reply(
+            c2, lambda: c1.call_player("shout", "hello world"),
+            lambda c: ("broadcast.all", "p1", "hello world") in _calls(c, "heard"),
+            timeout=15.0,
+        ), "pubsub publish never reached subscriber"
+
+        # mail through kvdb
+        assert wait_reply(
+            c2, lambda: c1.call_player("mail_to", c2.player.id, "mail body"),
+            lambda c: c.player.attrs.get("mails_got", 0) >= 1,
+        ), "mail delivery notification missing"
+        assert wait_reply(
+            c2, lambda: c2.call_player("read_mails"),
+            lambda c: _calls(c, "mails"),
+        )
+        assert any("mail body" in m for m in _calls(c2, "mails")[-1][0])
+
+        # filtered team broadcast reaches both (both team=blue)
+        c1.call_player("team_shout", "go team")
+        for c in (c1, c2):
+            assert c.wait_for(
+                lambda c: ("p1", "go team") in _calls(c, "team_heard"), 10
+            ), "team broadcast missing"
+
+        c1.close()
+        c2.close()
+    finally:
+        teardown_cluster(disp, games, gate)
+
+
+def test_nil_game(tmp_path):
+    mod = load_example("nil_game")
+    disp, games, gate = make_cluster(tmp_path, mod, "NilBoot")
+    try:
+        c = GameClientConnection(gate.addr)
+        assert c.wait_for(lambda c: c.player is not None, 10)
+        c.call_player("ping", 7)
+        assert c.wait_for(lambda c: (7,) in _calls(c, "pong"), 10)
+        c.close()
+    finally:
+        teardown_cluster(disp, games, gate)
