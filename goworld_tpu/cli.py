@@ -227,6 +227,59 @@ def cmd_reload(args) -> int:
     return 0
 
 
+def cmd_build(args) -> int:
+    """Build everything the cluster needs ahead of start (reference:
+    goworld build, build.go:9-56 -- go-builds the three binaries; here:
+    compile the native codec, byte-compile the framework + game script,
+    and validate the config)."""
+    import compileall
+    import py_compile
+
+    ok = True
+    # 1. native codec (used by the packet layer when present)
+    native_dir = os.path.join(os.path.dirname(__file__), "..", "native")
+    native_dir = os.path.abspath(native_dir)
+    if os.path.exists(os.path.join(native_dir, "Makefile")):
+        r = subprocess.run(
+            ["make", "-C", native_dir], capture_output=True, text=True
+        )
+        if r.returncode != 0:
+            print(f"native build failed:\n{r.stdout}{r.stderr}",
+                  file=sys.stderr)
+            ok = False
+        else:
+            print(f"native: {os.path.join(native_dir, 'libgwlz.so')}")
+    # 2. byte-compile the framework package
+    pkg_dir = os.path.dirname(os.path.abspath(__file__))
+    if not compileall.compile_dir(pkg_dir, quiet=2, force=False):
+        print("framework byte-compile failed", file=sys.stderr)
+        ok = False
+    else:
+        print(f"framework: {pkg_dir} byte-compiled")
+    # 3. the game script, if given
+    if args.script:
+        try:
+            py_compile.compile(args.script, doraise=True)
+            print(f"script: {args.script} OK")
+        except py_compile.PyCompileError as e:
+            print(f"script compile failed:\n{e}", file=sys.stderr)
+            ok = False
+    # 4. config validation (strict parse, same as the components do)
+    if args.config:
+        try:
+            cfg = gwconfig.load(args.config)
+            print(
+                f"config: {args.config} OK "
+                f"({len(cfg.dispatchers)} dispatcher(s), "
+                f"{len(cfg.games)} game(s), {len(cfg.gates)} gate(s))"
+            )
+        except Exception as e:
+            print(f"config invalid: {e}", file=sys.stderr)
+            ok = False
+    print("build OK" if ok else "build FAILED")
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="goworld_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -242,6 +295,10 @@ def main(argv=None) -> int:
             if name == "start":
                 p.add_argument("--restore", action="store_true")
         p.set_defaults(fn=fn)
+    p = sub.add_parser("build")
+    p.add_argument("-c", "--config", default=None)
+    p.add_argument("-s", "--script", default=None)
+    p.set_defaults(fn=cmd_build)
     args = ap.parse_args(argv)
     return args.fn(args)
 

@@ -8,13 +8,23 @@ framework (reference hot path: /root/reference/engine/entity/Space.go:253-261
 
 Layout (see aoi_predicate): planar packed words [C, W], W = C/32, where bit k
 of word [i, w] is the interest of entity i in entity j = k*W + w.  The kernel
-computes the full [TI, C] mask block on the VPU, then packs it on the MXU:
-``words = mask @ P`` where the constant banded matrix ``P[j, ws] = 2^(j//W)``
-iff ``j % W == ws``.  Because 2^31 exceeds exact f32 range the matmul is split
-into four byte planes (weights <= 128, partial sums <= 255 -- exact in f32)
-recombined with integer shifts.  This shape avoids the two Mosaic limits that
-rule out the direct formulations: dynamic lane-dim slices must be 128-aligned,
-and 2D->3D vector reshapes are unsupported.
+computes the full [TI, C] mask block on the VPU, then packs it one of two
+ways:
+
+  * ``W % 128 == 0`` (large capacities -- the hot sizes): pure-VPU
+    "slice-pack": word block w gathers bit k from the STATIC lane slice
+    ``mask[:, k*W:(k+1)*W]``, so packing is 32 shift-OR ops over 128-aligned
+    static slices.  No MXU, no per-step constants -- measured 1.6x faster
+    than the matmul pack at C=8192 on v5e (and exactly equal output).
+  * otherwise (small capacities, where static lane slices would break the
+    128-alignment rule): pack on the MXU as ``words = mask @ P`` with the
+    constant banded matrix ``P[j, ws] = 2^(j//W)`` iff ``j % W == ws``,
+    split into four byte planes (weights <= 128, partial sums <= 255 --
+    exact in f32) recombined with integer shifts.
+
+Both shapes avoid the two Mosaic limits that rule out direct formulations:
+dynamic lane-dim slices must be 128-aligned, and 2D->3D vector reshapes are
+unsupported.
 
 Active handling is folded into the inputs by the wrapper so the kernel has no
 mask operand:
@@ -41,7 +51,7 @@ from .aoi_predicate import WORD_BITS, words_per_row
 _INF = float("inf")
 
 
-def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_out, *, ti, w):
+def _mask_block(x_row, z_row, r_row, x_col, z_col, *, ti, w):
     bi = pl.program_id(1)
     c = WORD_BITS * w
     xr = x_row[0, 0].reshape(ti, 1)
@@ -52,7 +62,31 @@ def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_ou
     row_ids = bi * ti + jax.lax.broadcasted_iota(jnp.int32, (ti, 1), 0)
     col_ids = jax.lax.broadcasted_iota(jnp.int32, (ti, c), 1)
     m = (jnp.abs(xc - xr) <= rr) & (jnp.abs(zc - zr) <= rr)
-    m &= row_ids != col_ids
+    return m & (row_ids != col_ids)
+
+
+def _write_diff(acc, prev, new_out, ent_out, lv_out):
+    accu = jax.lax.bitcast_convert_type(acc, jnp.uint32)
+    pw = prev[0]
+    new_out[0] = accu
+    ent_out[0] = accu & ~pw
+    lv_out[0] = pw & ~accu
+
+
+def _aoi_kernel_slicepack(x_row, z_row, r_row, x_col, z_col, prev, new_out,
+                          ent_out, lv_out, *, ti, w):
+    m32 = _mask_block(
+        x_row, z_row, r_row, x_col, z_col, ti=ti, w=w
+    ).astype(jnp.int32)
+    acc = jnp.zeros((ti, w), jnp.int32)
+    for k in range(WORD_BITS):
+        acc = acc | (m32[:, k * w:(k + 1) * w] << k)
+    _write_diff(acc, prev, new_out, ent_out, lv_out)
+
+
+def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_out, *, ti, w):
+    c = WORD_BITS * w
+    m = _mask_block(x_row, z_row, r_row, x_col, z_col, ti=ti, w=w)
     mf = m.astype(jnp.float32)
 
     # Pack on the MXU, one byte plane per matmul (see module docstring).
@@ -66,11 +100,7 @@ def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_ou
         pb = jnp.where(band, jnp.exp2((k_ids - 8 * b).astype(jnp.float32)), 0.0)
         byte = jax.lax.dot(mf, pb, preferred_element_type=jnp.float32)
         acc = acc | (byte.astype(jnp.int32) << (8 * b))
-    accu = jax.lax.bitcast_convert_type(acc, jnp.uint32)
-    pw = prev[0]
-    new_out[0] = accu
-    ent_out[0] = accu & ~pw
-    lv_out[0] = pw & ~accu
+    _write_diff(acc, prev, new_out, ent_out, lv_out)
 
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
@@ -106,7 +136,10 @@ def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128, interpr
     words_spec = pl.BlockSpec((1, ti, w), lambda si, bi: (si, bi, 0))
     out_shape = jax.ShapeDtypeStruct((s, c, w), jnp.uint32)
 
-    kernel = functools.partial(_aoi_kernel, ti=ti, w=w)
+    if w % 128 == 0:
+        kernel = functools.partial(_aoi_kernel_slicepack, ti=ti, w=w)
+    else:
+        kernel = functools.partial(_aoi_kernel, ti=ti, w=w)
     return pl.pallas_call(
         kernel,
         grid=(s, c // ti),

@@ -137,3 +137,17 @@ def _logs(run):
         if fn.endswith(".log"):
             out.append(f"--- {fn} ---\n" + open(os.path.join(run, fn)).read()[-3000:])
     return "\n".join(out)
+
+
+def test_cli_build(rundir):
+    tmp_path, cfg, _gate_port = rundir
+    script = os.path.join(REPO, "examples", "unity_demo", "server.py")
+    r = cli(["build", "-c", cfg, "-s", script])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "build OK" in r.stdout
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    r = cli(["build", "-s", str(bad)])
+    assert r.returncode == 1
+    assert "build FAILED" in r.stdout
