@@ -31,22 +31,28 @@ def load_script(path: str):
     return mod
 
 
-def main():
+def main(argv=None, default_script: str | None = None):
     ap = argparse.ArgumentParser()
     ap.add_argument("-gid", type=int, default=1)
     ap.add_argument("-configfile", required=True)
-    ap.add_argument("-script", required=True)
+    ap.add_argument("-script", default=None)
     ap.add_argument("-restore", action="store_true")
     ap.add_argument("-log", default="info")
     ap.add_argument("-dir", default=".", help="runtime dir (freeze files, storage)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
+    script = args.script or default_script
+    if not script:
+        ap.error("-script is required")
     gwlog.setup(args.log)
     cfg = gwconfig.load(args.configfile)
-    mod = load_script(args.script)
+    mod = load_script(script)
 
     game = GameService(args.gid, cfg, freeze_dir=args.dir)
     game.attach_storage(args.dir)
     game.attach_kvdb(args.dir)
+    from ... import goworld as facade
+
+    facade.bind(game)
     mod.setup(game)
     game.start(restore=args.restore)
 

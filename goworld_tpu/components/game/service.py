@@ -30,6 +30,7 @@ from ...netutil import Packet
 from ...proto import GWConnection, msgtypes as MT
 from ...utils.asyncjobs import JobError
 from ...utils import binutil, gwlog, gwutils, gwvar
+from .lbc import LoadReporter
 
 
 class NilSpace(Space):
@@ -69,6 +70,7 @@ class GameService:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._registering_suppressed = False
+        self._lbc = LoadReporter()
         self.storage = None  # EntityStorageService, via attach_storage
         self.kvdb = None  # KVDBService, via attach_kvdb
         self.rt.entities.register(NilSpace, "__nil_space__")
@@ -155,6 +157,7 @@ class GameService:
         sync_s = self.gcfg.position_sync_interval_ms / 1000.0
         next_tick = time.monotonic() + tick_s
         next_sync = time.monotonic() + sync_s
+        next_lbc = time.monotonic() + 1.0
         while not self._stop.is_set():
             timeout = max(0.0, next_tick - time.monotonic())
             try:
@@ -169,8 +172,21 @@ class GameService:
                 if now >= next_sync:
                     self._send_position_syncs()
                     next_sync = now + sync_s
+                if now >= next_lbc:
+                    self._report_load()
+                    next_lbc = now + 1.0
                 self.cluster.flush_all()
                 next_tick = now + tick_s
+
+    def _report_load(self):
+        """Report CPU load to every dispatcher for LBC placement
+        (reference: gamelbc.go:17-39)."""
+        load = self._lbc.sample()
+        for conn in self.cluster.all():
+            try:
+                conn.send_game_lbc_info(load)
+            except OSError:
+                pass
 
     def step(self, n: int = 1):
         """Synchronous tick driver for tests (no background thread)."""
@@ -269,8 +285,16 @@ class GameService:
     def _h_create_entity_anywhere(self, pkt):
         eid = pkt.read_entity_id()
         type_name = pkt.read_varstr()
-        attrs = pkt.read_data()
-        self.rt.entities.create(type_name, eid=eid, attrs=attrs or {})
+        attrs = pkt.read_data() or {}
+        desc = self.rt.entities.registry.get(type_name)
+        if desc is not None and desc.is_space:
+            # space kind travels as a reserved attr, like the reference's
+            # _space_kind_ on the __space__ entity (CreateSpaceAnywhere)
+            kind = int(attrs.pop("_space_kind_", 1))
+            self.rt.entities.create_space(type_name, kind=kind, eid=eid,
+                                          attrs=attrs)
+        else:
+            self.rt.entities.create(type_name, eid=eid, attrs=attrs)
 
     def _h_load_entity_anywhere(self, pkt):
         eid = pkt.read_entity_id()

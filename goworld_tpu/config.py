@@ -70,20 +70,26 @@ class GateConfig:
 
 @dataclass
 class StorageConfig:
-    backend: str = "filesystem"  # filesystem | sqlite | redis
+    backend: str = "filesystem"  # filesystem|sqlite|redis|redis_cluster|mongodb|mysql
     directory: str = "entity_storage"  # directory-kind backends
-    host: str = "127.0.0.1"  # server-kind backends (redis)
+    host: str = "127.0.0.1"  # server-kind backends (redis/mongodb/mysql)
     port: int = 6379
     db: int = 0
+    addrs: str = ""  # cluster-kind backends: "host:port,host:port,..."
+    user: str = "root"  # sql-server backends (mysql)
+    password: str = ""
 
 
 @dataclass
 class KVDBConfig:
-    backend: str = "filesystem"  # filesystem | sqlite | redis
+    backend: str = "filesystem"  # filesystem|sqlite|redis|redis_cluster|mongodb|mysql
     directory: str = "kvdb"
     host: str = "127.0.0.1"
     port: int = 6379
     db: int = 0
+    addrs: str = ""  # cluster-kind backends: "host:port,host:port,..."
+    user: str = "root"  # sql-server backends (mysql)
+    password: str = ""
 
 
 @dataclass

@@ -97,8 +97,10 @@ class EntityManager:
             space.enter_entity(e, pos or Vector3())
         return e
 
-    def create_space(self, cls_name: str, kind: int = 1) -> "Space":
-        sp = self.create(cls_name)
+    def create_space(self, cls_name: str, kind: int = 1,
+                     eid: str | None = None,
+                     attrs: dict | None = None) -> "Space":
+        sp = self.create(cls_name, eid=eid, attrs=attrs)
         sp.kind = kind  # type: ignore[attr-defined]
         sp.on_space_init()  # type: ignore[attr-defined]
         return sp  # type: ignore[return-value]

@@ -21,6 +21,10 @@ class MiniRedis:
         self._dbs: dict[int, dict[bytes, bytes]] = {}
         self._zsets: dict[int, dict[bytes, set[bytes]]] = {}
         self._lock = threading.Lock()
+        # cluster mode (set by MiniRedisCluster): this node's slot range and
+        # the full topology for CLUSTER SLOTS / -MOVED replies
+        self.slot_range: tuple[int, int] | None = None
+        self.cluster_view: list[tuple[int, int, tuple[str, int]]] = []
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -88,12 +92,53 @@ class MiniRedis:
                     db = int(args[1])
                     sock.sendall(b"+OK\r\n")
                     continue
+                if cmd == "CLUSTER":
+                    sock.sendall(self._cluster_reply(args[1:]))
+                    continue
+                moved = self._check_slot(cmd, args[1:])
+                if moved is not None:
+                    sock.sendall(moved)
+                    continue
                 reply = self._execute(db, cmd, args[1:])
                 sock.sendall(reply)
         except OSError:
             pass
         finally:
             sock.close()
+
+    # -- cluster mode ------------------------------------------------------
+    _KEYED = frozenset({
+        "GET", "SET", "SETNX", "EXISTS", "DEL", "ZADD", "ZREM",
+        "ZRANGEBYLEX", "MGET",
+    })
+
+    def _cluster_reply(self, args: list[bytes]) -> bytes:
+        sub = args[0].upper().decode("ascii") if args else ""
+        if sub == "SLOTS" and self.cluster_view:
+            out = [b"*%d\r\n" % len(self.cluster_view)]
+            for start, end, (host, port) in self.cluster_view:
+                hostb = host.encode("utf-8")
+                out.append(
+                    b"*3\r\n:%d\r\n:%d\r\n*2\r\n$%d\r\n%s\r\n:%d\r\n"
+                    % (start, end, len(hostb), hostb, port)
+                )
+            return b"".join(out)
+        return b"-ERR This instance has cluster support disabled\r\n"
+
+    def _check_slot(self, cmd: str, args: list[bytes]) -> bytes | None:
+        """-MOVED for keys this node does not own (cluster mode only)."""
+        if self.slot_range is None or cmd not in self._KEYED or not args:
+            return None
+        from .respcluster import key_slot
+
+        slot = key_slot(args[0])
+        lo, hi = self.slot_range
+        if lo <= slot <= hi:
+            return None
+        for start, end, (host, port) in self.cluster_view:
+            if start <= slot <= end:
+                return b"-MOVED %d %s:%d\r\n" % (slot, host.encode(), port)
+        return b"-CLUSTERDOWN Hash slot not served\r\n"
 
     # -- commands ----------------------------------------------------------
     def _kv(self, db: int) -> dict[bytes, bytes]:
@@ -193,3 +238,31 @@ class MiniRedis:
 
                 return self._array([m for m in out if keep(m)])
             return b"-ERR unknown command '%s'\r\n" % cmd.encode()
+
+
+class MiniRedisCluster:
+    """N MiniRedis nodes with the 16384 slots split evenly between them --
+    a hermetic stand-in for a real redis cluster (reference CI uses live
+    services; this image has none)."""
+
+    def __init__(self, n_nodes: int = 3, host: str = "127.0.0.1"):
+        from .respcluster import SLOTS
+
+        self.nodes = [MiniRedis(host) for _ in range(n_nodes)]
+        per = SLOTS // n_nodes
+        view = []
+        for i, node in enumerate(self.nodes):
+            start = i * per
+            end = SLOTS - 1 if i == n_nodes - 1 else (i + 1) * per - 1
+            node.slot_range = (start, end)
+            view.append((start, end, node.addr))
+        for node in self.nodes:
+            node.cluster_view = view
+
+    @property
+    def addrs(self) -> list[tuple[str, int]]:
+        return [n.addr for n in self.nodes]
+
+    def close(self):
+        for n in self.nodes:
+            n.close()

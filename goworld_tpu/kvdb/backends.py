@@ -218,10 +218,120 @@ class RedisKVDB(KVDBBackend):
         self._c.close()
 
 
+class RedisClusterKVDB(RedisKVDB):
+    """Redis-cluster kvdb (reference: kvdb/backend/kvdb_redis_cluster).
+    Same schema as the redis kvdb, through the slot-aware cluster client.
+    ``find`` issues per-key GETs instead of one MGET -- the keys span slots
+    and cross-slot multi-key commands are illegal in a cluster."""
+
+    config_kind = "cluster"
+
+    def __init__(self, addrs: str | list[tuple[str, int]]):
+        from ..ext.db.dbutil import parse_addrs
+        from ..ext.db.respcluster import RespClusterClient
+
+        self._c = RespClusterClient(parse_addrs(addrs))
+
+    def find(self, begin: str, end: str) -> list[tuple[str, str]]:
+        if end == "":
+            return []
+        lo = "-" if begin == "" else f"[{begin}"
+        members = self._c.command(
+            "ZRANGEBYLEX", self._INDEX, lo, f"({end}"
+        )
+        out = []
+        for m in members or []:
+            k = m.decode("utf-8")
+            v = self._c.command("GET", self._key(k))
+            if v is not None:
+                out.append((k, v.decode("utf-8")))
+        return out
+
+
+class MongoKVDB(KVDBBackend):
+    """MongoDB kvdb (reference: kvdb/backend/kvdb_mongodb).  Gated on
+    pymongo (not in this image)."""
+
+    config_kind = "server"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017,
+                 db: int | str = "goworld"):
+        try:
+            import pymongo
+        except ImportError as e:
+            raise RuntimeError(
+                "the mongodb kvdb backend requires the pymongo driver"
+            ) from e
+        from ..ext.db.dbutil import db_name
+
+        self._client = pymongo.MongoClient(host, port)
+        self._col = self._client[db_name(db)]["kvdb"]
+
+    def get(self, key: str) -> str | None:
+        doc = self._col.find_one({"_id": key})
+        return doc["v"] if doc else None
+
+    def put(self, key: str, val: str) -> None:
+        self._col.replace_one({"_id": key}, {"_id": key, "v": val},
+                              upsert=True)
+
+    def find(self, begin: str, end: str) -> list[tuple[str, str]]:
+        cur = self._col.find(
+            {"_id": {"$gte": begin, "$lt": end}}
+        ).sort("_id", 1)
+        return [(d["_id"], d["v"]) for d in cur]
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class MySQLKVDB(KVDBBackend):
+    """MySQL kvdb (reference: kvdb/backend/kvdb_mysql).  Gated on a MySQL
+    driver (pymysql / mysql.connector; not in this image)."""
+
+    config_kind = "sql_server"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 3306,
+                 db: int | str = "goworld", user: str = "root",
+                 password: str = ""):
+        from ..ext.db.dbutil import connect_mysql, db_name
+
+        self._db = connect_mysql(host, port, user, password, db_name(db))
+        cur = self._db.cursor()
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS kv"
+            " (k VARCHAR(255) PRIMARY KEY, v TEXT NOT NULL)"
+        )
+
+    def get(self, key: str) -> str | None:
+        cur = self._db.cursor()
+        cur.execute("SELECT v FROM kv WHERE k = %s", (key,))
+        row = cur.fetchone()
+        return None if row is None else row[0]
+
+    def put(self, key: str, val: str) -> None:
+        cur = self._db.cursor()
+        cur.execute("REPLACE INTO kv (k, v) VALUES (%s, %s)", (key, val))
+
+    def find(self, begin: str, end: str) -> list[tuple[str, str]]:
+        cur = self._db.cursor()
+        cur.execute(
+            "SELECT k, v FROM kv WHERE k >= %s AND k < %s ORDER BY k",
+            (begin, end),
+        )
+        return [(k, v) for k, v in cur.fetchall()]
+
+    def close(self) -> None:
+        self._db.close()
+
+
 _REGISTRY = {
     "filesystem": FilesystemKVDB,
     "sqlite": SqliteKVDB,
     "redis": RedisKVDB,
+    "redis_cluster": RedisClusterKVDB,
+    "mongodb": MongoKVDB,
+    "mysql": MySQLKVDB,
 }
 
 
@@ -247,6 +357,6 @@ def config_kwargs(backend: str, cfg, base_dir: str = ".") -> dict:
         raise ValueError(
             f"unknown kvdb backend {backend!r} (have {sorted(_REGISTRY)})"
         )
-    if getattr(cls, "config_kind", "directory") == "server":
-        return {"host": cfg.host, "port": cfg.port, "db": cfg.db}
-    return {"directory": os.path.join(base_dir, cfg.directory)}
+    from ..ext.db.dbutil import backend_config_kwargs
+
+    return backend_config_kwargs(cls, cfg, base_dir)

@@ -14,7 +14,11 @@ mysql, storage/backend/*):
     sqlite3, one ``entities(type, eid, data)`` table;
   * ``redis``      -- RESP protocol via ext/db/resp; keys
     ``storage:<type>:<eid>`` holding msgpack blobs, tested hermetically
-    against ext/db/miniredis.
+    against ext/db/miniredis;
+  * ``redis_cluster`` -- same schema through the slot-aware cluster client
+    (ext/db/respcluster), tested against MiniRedisCluster;
+  * ``mongodb`` / ``mysql`` -- driver-gated (pymongo / pymysql|mysql-connector,
+    neither in this image); constructors raise a clear error when absent.
 """
 
 from __future__ import annotations
@@ -178,10 +182,136 @@ class RedisEntityStorage(EntityStorageBackend):
         self._c.close()
 
 
+class RedisClusterEntityStorage(RedisEntityStorage):
+    """Redis-cluster backend (reference: backend/redis_cluster): same key
+    schema as the redis backend, routed through the slot-aware cluster
+    client (ext/db/respcluster) with MOVED/ASK handling.  Keys carry a
+    ``{type}`` hash tag so an entity's blob and its type's list index live
+    on the same node."""
+
+    config_kind = "cluster"
+
+    def __init__(self, addrs: str | list[tuple[str, int]]):
+        from ..ext.db.dbutil import parse_addrs
+        from ..ext.db.respcluster import RespClusterClient
+
+        self._c = RespClusterClient(parse_addrs(addrs))
+
+    @staticmethod
+    def _key(type_name: str, eid: str) -> str:
+        return f"storage:{{{type_name}}}:{eid}"
+
+    @staticmethod
+    def _index(type_name: str) -> str:
+        return f"storage-index:{{{type_name}}}"
+
+
+class MongoEntityStorage(EntityStorageBackend):
+    """MongoDB backend (reference: backend/mongodb/mongodb.go).  Gated on
+    the pymongo driver (not in this image); one collection per entity type,
+    documents ``{_id: eid, data: <bson-safe attrs>}``."""
+
+    config_kind = "server"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017,
+                 db: int | str = "goworld"):
+        try:
+            import pymongo
+        except ImportError as e:
+            raise RuntimeError(
+                "the mongodb storage backend requires the pymongo driver"
+            ) from e
+        from ..ext.db.dbutil import db_name
+
+        self._client = pymongo.MongoClient(host, port)
+        name = db_name(db)
+        self._db = self._client[name]
+
+    def write(self, type_name: str, eid: str, data: dict) -> None:
+        self._db[type_name].replace_one(
+            {"_id": eid}, {"_id": eid, "data": data}, upsert=True
+        )
+
+    def read(self, type_name: str, eid: str) -> dict | None:
+        doc = self._db[type_name].find_one({"_id": eid})
+        return doc["data"] if doc else None
+
+    def exists(self, type_name: str, eid: str) -> bool:
+        return self._db[type_name].count_documents({"_id": eid}, limit=1) > 0
+
+    def list_entity_ids(self, type_name: str) -> list[str]:
+        return sorted(
+            d["_id"] for d in self._db[type_name].find({}, {"_id": 1})
+        )
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class MySQLEntityStorage(EntityStorageBackend):
+    """MySQL backend (reference: backend/mysql/entity_storage_mysql.go).
+    Gated on a MySQL driver (pymysql / mysql.connector; not in this image).
+    Same table shape as the sqlite backend."""
+
+    config_kind = "sql_server"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 3306,
+                 db: int | str = "goworld", user: str = "root",
+                 password: str = ""):
+        from ..ext.db.dbutil import connect_mysql, db_name
+
+        self._db = connect_mysql(host, port, user, password, db_name(db))
+        cur = self._db.cursor()
+        cur.execute(
+            "CREATE TABLE IF NOT EXISTS entities ("
+            " type VARCHAR(64) NOT NULL, eid VARCHAR(32) NOT NULL,"
+            " data BLOB NOT NULL, PRIMARY KEY (type, eid))"
+        )
+
+    def write(self, type_name: str, eid: str, data: dict) -> None:
+        blob = msgpack.packb(data, use_bin_type=True)
+        cur = self._db.cursor()
+        cur.execute(
+            "REPLACE INTO entities (type, eid, data) VALUES (%s, %s, %s)",
+            (type_name, eid, blob),
+        )
+
+    def read(self, type_name: str, eid: str) -> dict | None:
+        cur = self._db.cursor()
+        cur.execute(
+            "SELECT data FROM entities WHERE type = %s AND eid = %s",
+            (type_name, eid),
+        )
+        row = cur.fetchone()
+        return msgpack.unpackb(row[0], raw=False) if row else None
+
+    def exists(self, type_name: str, eid: str) -> bool:
+        cur = self._db.cursor()
+        cur.execute(
+            "SELECT 1 FROM entities WHERE type = %s AND eid = %s",
+            (type_name, eid),
+        )
+        return cur.fetchone() is not None
+
+    def list_entity_ids(self, type_name: str) -> list[str]:
+        cur = self._db.cursor()
+        cur.execute(
+            "SELECT eid FROM entities WHERE type = %s ORDER BY eid",
+            (type_name,),
+        )
+        return [r[0] for r in cur.fetchall()]
+
+    def close(self) -> None:
+        self._db.close()
+
+
 _REGISTRY = {
     "filesystem": FilesystemEntityStorage,
     "sqlite": SqliteEntityStorage,
     "redis": RedisEntityStorage,
+    "redis_cluster": RedisClusterEntityStorage,
+    "mongodb": MongoEntityStorage,
+    "mysql": MySQLEntityStorage,
 }
 
 
@@ -199,15 +329,13 @@ def new_entity_storage(backend: str, **kwargs) -> EntityStorageBackend:
 
 
 def config_kwargs(backend: str, cfg, base_dir: str = ".") -> dict:
-    """Constructor kwargs for a backend from its config section.  The
-    backend class declares its kind via ``config_kind``: "server" consumes
-    host/port/db; the default ("directory") consumes directory -- so
-    backends added through register_backend pick their own keys."""
+    """Constructor kwargs for a backend from its config section (see
+    ext/db/dbutil.backend_config_kwargs for the config_kind contract)."""
     cls = _REGISTRY.get(backend)
     if cls is None:
         raise ValueError(
             f"unknown storage backend {backend!r} (have {sorted(_REGISTRY)})"
         )
-    if getattr(cls, "config_kind", "directory") == "server":
-        return {"host": cfg.host, "port": cfg.port, "db": cfg.db}
-    return {"directory": os.path.join(base_dir, cfg.directory)}
+    from ..ext.db.dbutil import backend_config_kwargs
+
+    return backend_config_kwargs(cls, cfg, base_dir)

@@ -96,11 +96,12 @@ def test_cli_start_bots_reload_stop(rundir):
     from goworld_tpu.client import GameClientConnection
 
     keeper = GameClientConnection(("127.0.0.1", gate_port))
-    assert keeper.wait_for(lambda c: c.player is not None, 15)
+    assert keeper.wait_for(lambda c: c.player is not None, 30), \
+        "boot entity never reached keeper client\n" + _logs(run)
     keeper.call_player("enter_game", "keeper")
     assert keeper.wait_for(
-        lambda c: c.player.attrs.get("name") == "keeper", 15
-    )
+        lambda c: c.player.attrs.get("name") == "keeper", 30
+    ), "enter_game attr change never reached keeper client\n" + _logs(run)
 
     r = cli(["reload", "-c", cfg, "-s", script, "-d", run])
     assert r.returncode == 0, f"reload failed:\n{r.stdout}\n{r.stderr}\n" + _logs(run)

@@ -1,0 +1,127 @@
+"""Facade API (goworld_tpu.goworld): the one-import dev surface
+(reference: goworld.go:34-231)."""
+
+import time
+
+import pytest
+
+from goworld_tpu import config as gwconfig, goworld
+from goworld_tpu.components.dispatcher.service import DispatcherService
+from goworld_tpu.components.game.service import GameService
+
+CONFIG = """
+[deployment]
+dispatchers = 1
+games = 2
+gates = 0
+
+[dispatcher1]
+port = 0
+
+[game_common]
+aoi_backend = cpu
+
+[storage]
+backend = filesystem
+
+[kvdb]
+backend = filesystem
+"""
+
+
+class Arena(goworld.Space):
+    inited_kinds = []
+
+    def on_space_init(self):
+        Arena.inited_kinds.append(self.kind)
+
+
+class Pawn(goworld.Entity):
+    greetings = []
+
+    @goworld.rpc
+    def greet(self, text):
+        Pawn.greetings.append((self.id, text))
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    cfg = gwconfig.loads(CONFIG)
+    disp = DispatcherService(1, cfg).start()
+    cfg.dispatchers[1].host, cfg.dispatchers[1].port = disp.addr
+    games = []
+    for gid in (1, 2):
+        gs = GameService(gid, cfg, freeze_dir=str(tmp_path))
+        gs.attach_storage(str(tmp_path / f"g{gid}"))
+        gs.attach_kvdb(str(tmp_path / f"g{gid}"))
+        gs.register_entity_type(Arena)
+        gs.register_entity_type(Pawn)
+        gs.start()
+        games.append(gs)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not all(g.deployment_ready for g in games):
+        time.sleep(0.01)
+    assert all(g.deployment_ready for g in games)
+    goworld.bind(games[0])
+    yield disp, games
+    goworld.bind(None)
+    for g in games:
+        g.stop()
+    disp.stop()
+
+
+def on_logic(game, fn, timeout=5.0):
+    """Run fn on the game logic thread and return its result."""
+    box = []
+    game.rt.post.post(lambda: box.append(fn()))
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and not box:
+        time.sleep(0.005)
+    assert box, "posted function never ran"
+    return box[0]
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_facade_surface(cluster):
+    disp, (g1, g2) = cluster
+    Arena.inited_kinds.clear()
+    Pawn.greetings.clear()
+
+    # local creation + nil space + lookup
+    def local_ops():
+        sp = goworld.create_space_locally("Arena", kind=3)
+        p = goworld.create_entity_locally("Pawn", space=sp)
+        assert goworld.get_entity(p.id) is p
+        assert goworld.nil_space() is g1.nil_space
+        assert goworld.get_game_id() == 1
+        goworld.call(p.id, "greet", "local")
+        return p.id
+
+    pid = on_logic(g1, local_ops)
+    assert _wait(lambda: (pid, "local") in Pawn.greetings)
+    assert Arena.inited_kinds == [3]
+
+    # anywhere-creation of a space runs on_space_init with the right kind on
+    # whichever game it lands on (class state is shared in-process)
+    on_logic(g1, lambda: goworld.create_space_anywhere("Arena", kind=7))
+    assert _wait(lambda: 7 in Arena.inited_kinds), Arena.inited_kinds
+
+    # kvdb helpers round-trip through the async worker + post queue
+    got = []
+    on_logic(g1, lambda: goworld.kvdb_put("k1", "v1", lambda _:
+             goworld.kvdb_get("k1", got.append)))
+    assert _wait(lambda: got == ["v1"]), got
+
+
+def test_facade_unbound():
+    goworld.bind(None)
+    with pytest.raises(RuntimeError):
+        goworld.current_game()

@@ -1,0 +1,136 @@
+"""gwdoc: the async document-DB wrapper + embedded engine (reference:
+ext/db/gwmongo/gwmongo.go -- insert/find/update/upsert/remove/index ops with
+logic-thread callbacks; here over the built-in DocStore engine)."""
+
+import time
+
+import pytest
+
+from goworld_tpu.ext.db.gwdoc import DocStore, GWDoc, apply_update, match
+
+
+# -- query matcher -----------------------------------------------------------
+
+def test_match_operators():
+    doc = {"_id": "1", "name": "bob", "lv": 7, "tags": ["a", "b"],
+           "eq": {"weapon": {"dmg": 12}}}
+    assert match(doc, {})
+    assert match(doc, {"name": "bob"})
+    assert not match(doc, {"name": "alice"})
+    assert match(doc, {"lv": {"$gt": 5, "$lte": 7}})
+    assert not match(doc, {"lv": {"$gt": 7}})
+    assert match(doc, {"lv": {"$ne": 8}})
+    assert not match(doc, {"lv": {"$ne": 7}})
+    assert match(doc, {"name": {"$in": ["bob", "carl"]}})
+    assert match(doc, {"name": {"$nin": ["alice"]}})
+    assert not match(doc, {"name": {"$nin": ["bob"]}})
+    # mongo semantics: $nin matches docs missing the field; $in does not
+    assert match(doc, {"missing": {"$nin": ["x"]}})
+    assert not match(doc, {"missing": {"$in": ["x"]}})
+    assert match(doc, {"eq.weapon.dmg": 12})
+    assert match(doc, {"eq.weapon.dmg": {"$gte": 10}})
+    assert match(doc, {"missing": {"$exists": False}})
+    assert match(doc, {"lv": {"$exists": True}})
+    assert not match(doc, {"missing": 3})
+    assert not match(doc, {"missing": {"$gt": 1}})  # missing never compares
+    assert match(doc, {"tags": "a"})  # list-membership equality
+    assert match(doc, {"$or": [{"name": "alice"}, {"lv": 7}]})
+    assert not match(doc, {"$and": [{"name": "bob"}, {"lv": 8}]})
+    with pytest.raises(ValueError):
+        match(doc, {"lv": {"$regex": "x"}})
+
+
+def test_apply_update():
+    doc = {"_id": "1", "a": 1, "b": {"c": 2}, "arr": [1]}
+    assert apply_update(doc, {"$set": {"b.d": 5}})["b"] == {"c": 2, "d": 5}
+    assert apply_update(doc, {"$inc": {"a": 3}})["a"] == 4
+    assert apply_update(doc, {"$inc": {"new": 2}})["new"] == 2
+    assert apply_update(doc, {"$unset": {"a": 1}}).get("a") is None
+    assert apply_update(doc, {"$push": {"arr": 2}})["arr"] == [1, 2]
+    # full replacement keeps _id
+    new = apply_update(doc, {"x": 9})
+    assert new == {"_id": "1", "x": 9}
+    assert doc["a"] == 1  # original untouched
+
+
+# -- embedded engine ---------------------------------------------------------
+
+def test_docstore_crud(tmp_path):
+    db = DocStore(str(tmp_path / "docs.sqlite"))
+    i1 = db.insert("avatars", {"name": "bob", "lv": 3})
+    db.insert("avatars", {"_id": "a2", "name": "alice", "lv": 9})
+    db.insert("monsters", {"name": "slime"})
+
+    assert db.count("avatars") == 2
+    assert db.find_id("avatars", "a2")["name"] == "alice"
+    assert db.find_one("avatars", {"lv": {"$gt": 5}})["name"] == "alice"
+    assert [d["name"] for d in db.find("avatars", sort="-lv")] == \
+        ["alice", "bob"]
+    assert db.find("avatars", limit=1, sort="lv")[0]["name"] == "bob"
+
+    assert db.update_id("avatars", i1, {"$inc": {"lv": 1}}) == 1
+    assert db.find_id("avatars", i1)["lv"] == 4
+    assert db.update("avatars", {"lv": {"$gt": 0}},
+                     {"$set": {"guild": "g"}}, multi=True) == 2
+    assert db.count("avatars", {"guild": "g"}) == 2
+
+    # upsert: miss creates, hit updates
+    assert db.upsert_id("avatars", "a3", {"$set": {"name": "carl"}}) == 1
+    assert db.find_id("avatars", "a3")["name"] == "carl"
+    assert db.upsert_id("avatars", "a3", {"$set": {"lv": 1}}) == 1
+    assert db.find_id("avatars", "a3") == {"_id": "a3", "name": "carl",
+                                           "lv": 1}
+
+    assert db.remove_id("avatars", "a3") == 1
+    assert db.remove("avatars", {"guild": "g"}) == 2
+    assert db.count("avatars") == 0
+    assert db.count("monsters") == 1  # other collections untouched
+
+    db.ensure_index("monsters", "name")
+    db.ensure_index("monsters", "name")  # idempotent
+    assert db.indexes("monsters") == ["name"]
+    db.drop_collection("monsters")
+    assert db.count("monsters") == 0
+    assert db.indexes("monsters") == []
+    db.close()
+
+
+def test_docstore_persistence(tmp_path):
+    path = str(tmp_path / "docs.sqlite")
+    db = DocStore(path)
+    db.insert("c", {"_id": "x", "v": 1})
+    db.close()
+    db2 = DocStore(path)
+    assert db2.find_id("c", "x") == {"_id": "x", "v": 1}
+    db2.close()
+
+
+# -- async wrapper -----------------------------------------------------------
+
+def _wait(box, n=1, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and len(box) < n:
+        time.sleep(0.005)
+    assert len(box) >= n, f"only {len(box)}/{n} callbacks arrived"
+
+
+def test_gwdoc_async_ordering(tmp_path):
+    posted = []
+    db = GWDoc(str(tmp_path / "docs.sqlite"), post=lambda fn: posted.append(fn))
+    got = []
+    db.insert("c", {"_id": "k", "v": 1}, callback=got.append)
+    db.update_id("c", "k", {"$inc": {"v": 10}}, callback=got.append)
+    db.find_id("c", "k", callback=got.append)
+    db.count("c", callback=got.append)
+    # callbacks are delivered through post in submission order
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and len(posted) < 4:
+        time.sleep(0.005)
+    for fn in posted:
+        fn()
+    _wait(got, 4)
+    assert got[0] == "k"
+    assert got[1] == 1
+    assert got[2] == {"_id": "k", "v": 11}
+    assert got[3] == 1
+    db.close()

@@ -1,0 +1,80 @@
+"""Least-loaded placement: games report CPU load each second (reference:
+components/game/lbc/gamelbc.go:17-39) and the dispatcher's LBC picker
+(DispatcherService.go:529-542, lbcheap.go) places CreateEntityAnywhere on the
+least-loaded game, with a +0.1 virtual-load nudge per pick."""
+
+import time
+
+import pytest
+
+from goworld_tpu import config as gwconfig
+from goworld_tpu.components.dispatcher.service import DispatcherService
+from goworld_tpu.components.game.service import GameService
+from goworld_tpu.engine.entity import Entity
+
+CONFIG = """
+[deployment]
+dispatchers = 1
+games = 2
+gates = 0
+
+[dispatcher1]
+port = 0
+
+[game_common]
+aoi_backend = cpu
+"""
+
+
+class Worker(Entity):
+    pass
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    cfg = gwconfig.loads(CONFIG)
+    disp = DispatcherService(1, cfg).start()
+    cfg.dispatchers[1].host, cfg.dispatchers[1].port = disp.addr
+    games = []
+    for gid in (1, 2):
+        gs = GameService(gid, cfg, freeze_dir=str(tmp_path))
+        gs.register_entity_type(Worker)
+        gs.start()
+        games.append(gs)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not all(g.deployment_ready for g in games):
+        time.sleep(0.01)
+    assert all(g.deployment_ready for g in games)
+    yield disp, games
+    for g in games:
+        g.stop()
+    disp.stop()
+
+
+def test_lbc_reports_steer_placement(cluster):
+    disp, (g1, g2) = cluster
+
+    # game1 pretends to be busy, game2 idle; the 1 s reporters propagate it
+    g1._lbc.sample = lambda: 5.0
+    g2._lbc.sample = lambda: 0.0
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and not (
+        disp.games.get(1) and disp.games[1].load >= 5.0
+    ):
+        time.sleep(0.05)
+    assert disp.games[1].load >= 5.0, "game1 load report never arrived"
+
+    # 6 anywhere-creations: 0.0 + 6 * 0.1 virtual nudge stays < 5.0, so every
+    # one must land on the idle game2
+    for _ in range(6):
+        g1.create_entity_anywhere("Worker")
+    deadline = time.monotonic() + 5
+    want = lambda: sum(
+        1 for e in g2.rt.entities.entities.values() if e.type_name == "Worker"
+    )
+    while time.monotonic() < deadline and want() < 6:
+        time.sleep(0.05)
+    assert want() == 6, f"only {want()} of 6 landed on the idle game"
+    assert not any(
+        e.type_name == "Worker" for e in g1.rt.entities.entities.values()
+    )
