@@ -247,12 +247,38 @@ class DocStore:
             return n
         return len(self.find(col, query))
 
+    def _hits_locked(self, col: str, query: dict, multi: bool) -> list[dict]:
+        """Matching docs; pure-_id-equality queries use the keyed row lookup
+        instead of scanning and decoding the whole collection."""
+        if set(query) == {"_id"} and not isinstance(query["_id"], dict):
+            row = self._db.execute(
+                "SELECT data FROM docs WHERE col = ? AND id = ?",
+                (col, str(query["_id"])),
+            ).fetchone()
+            return [msgpack.unpackb(row[0], raw=False)] if row else []
+        hits = [d for d in self._iter(col) if match(d, query)]
+        return hits if multi else hits[:1]
+
+    @staticmethod
+    def _upsert_base(query: dict) -> dict:
+        """Seed document from the equality parts of an upsert's query,
+        expanding dotted paths into nested dicts (mongo upsert rules)."""
+        base: dict = {}
+        for k, v in query.items():
+            if k.startswith("$"):
+                continue
+            if isinstance(v, dict) and any(x.startswith("$") for x in v):
+                continue  # operator condition: contributes no seed value
+            _set_path(base, k, v)
+        if not isinstance(base.get("_id"), (str, int)):
+            base.pop("_id", None)
+        base.setdefault("_id", gen_id())
+        return base
+
     def update(self, col: str, query: dict, update: dict,
                multi: bool = False, upsert: bool = False) -> int:
         with self._lock:
-            hits = [d for d in self._iter(col) if match(d, query)]
-            if not multi:
-                hits = hits[:1]
+            hits = self._hits_locked(col, query, multi)
             for d in hits:
                 new = apply_update(d, update)
                 self._db.execute(
@@ -260,17 +286,19 @@ class DocStore:
                     (msgpack.packb(new, use_bin_type=True), col,
                      str(d["_id"])),
                 )
+            if not hits and upsert:
+                # inside the same critical section: a concurrent upsert must
+                # not also see "no hits" and double-insert
+                doc = apply_update(self._upsert_base(query), update)
+                self._db.execute(
+                    "INSERT OR REPLACE INTO docs (col, id, data)"
+                    " VALUES (?,?,?)",
+                    (col, str(doc["_id"]),
+                     msgpack.packb(doc, use_bin_type=True)),
+                )
+                self._db.commit()
+                return 1
             self._db.commit()
-        if not hits and upsert:
-            base = {
-                k: v for k, v in query.items() if not k.startswith("$")
-                and not (isinstance(v, dict)
-                         and any(x.startswith("$") for x in v))
-            }
-            doc = apply_update({**base, "_id": query.get("_id") or gen_id()},
-                               update)
-            self.insert(col, doc)
-            return 1
         return len(hits)
 
     def update_id(self, col: str, _id: str, update: dict) -> int:
@@ -281,9 +309,7 @@ class DocStore:
 
     def remove(self, col: str, query: dict, multi: bool = True) -> int:
         with self._lock:
-            hits = [d for d in self._iter(col) if match(d, query)]
-            if not multi:
-                hits = hits[:1]
+            hits = self._hits_locked(col, query, multi)
             for d in hits:
                 self._db.execute(
                     "DELETE FROM docs WHERE col = ? AND id = ?",
@@ -370,7 +396,8 @@ class PymongoEngine:
             res = self._db[col].update_many(query, update, upsert=upsert)
         else:
             res = self._db[col].update_one(query, update, upsert=upsert)
-        return res.modified_count + (1 if res.upserted_id is not None else 0)
+        # matched (not modified) count mirrors DocStore.update's return
+        return res.matched_count + (1 if res.upserted_id is not None else 0)
 
     def update_id(self, col: str, _id: str, update: dict) -> int:
         return self.update(col, {"_id": _id}, update)

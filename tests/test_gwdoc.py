@@ -74,6 +74,18 @@ def test_docstore_crud(tmp_path):
                      {"$set": {"guild": "g"}}, multi=True) == 2
     assert db.count("avatars", {"guild": "g"}) == 2
 
+    # upsert with dotted-path and operator-valued query conditions (mongo
+    # seeding rules: dotted paths nest; operator conds contribute nothing)
+    assert db.update("gear", {"owner.name": "z", "lv": {"$gt": 3}},
+                     {"$set": {"slot": 1}}, upsert=True) == 1
+    seeded = db.find_one("gear", {"owner.name": "z"})
+    assert seeded is not None and seeded["owner"] == {"name": "z"}
+    assert seeded["slot"] == 1 and "lv" not in seeded
+    assert db.update("gear", {"_id": {"$gt": "a"}}, {"$set": {"x": 1}},
+                     upsert=True) == 1
+    assert all(isinstance(d["_id"], str) for d in db.find("gear"))
+    db.drop_collection("gear")
+
     # upsert: miss creates, hit updates
     assert db.upsert_id("avatars", "a3", {"$set": {"name": "carl"}}) == 1
     assert db.find_id("avatars", "a3")["name"] == "carl"
