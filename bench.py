@@ -43,6 +43,17 @@ CHUNK = int(os.environ.get("BENCH_CHUNK", 5))
 CPU_TICKS = int(os.environ.get("BENCH_CPU_TICKS", 3))
 MAX_WORDS = int(os.environ.get("BENCH_MAX_WORDS", 1 << 17))
 ZIPF = os.environ.get("BENCH_ZIPF", "") == "1"  # hotspot density config
+VAR_RADIUS = os.environ.get("BENCH_VAR_RADIUS", "") == "1"  # per-entity radius
+
+
+def make_radius():
+    """[S, CAP] f32 radii: fixed, or per-entity in [0.5r, 1.5r] (the
+    BASELINE.json "variable AOI radius / asymmetric interest" config)."""
+    if VAR_RADIUS:
+        rng = np.random.default_rng(7)
+        return rng.uniform(0.5 * RADIUS, 1.5 * RADIUS,
+                           (S, CAP)).astype(np.float32)
+    return np.full((S, CAP), RADIUS, np.float32)
 
 
 def make_walks(ticks, seed=0):
@@ -87,17 +98,19 @@ def bench_tpu(xs, zs):
     from goworld_tpu.ops.events import expand_words_host, extract_nonzero_words
 
     w = words_per_row(CAP)
-    r = jnp.full((S, CAP), RADIUS, jnp.float32)
+    r = jnp.asarray(make_radius())
     act = jnp.ones((S, CAP), bool)
 
-    @jax.jit
-    def run(xs, zs, prev):
-        def step(prev, xz):
-            x, z = xz
-            new, ent, lv = aoi_step_pallas(x, z, r, act, prev)
-            return new, (extract_nonzero_words(ent, MAX_WORDS),
-                         extract_nonzero_words(lv, MAX_WORDS))
-        return jax.lax.scan(step, prev, (xs, zs))
+    def make_run(mw):
+        @jax.jit
+        def run(xs, zs, prev):
+            def step(prev, xz):
+                x, z = xz
+                new, ent, lv = aoi_step_pallas(x, z, r, act, prev)
+                return new, (extract_nonzero_words(ent, mw),
+                             extract_nonzero_words(lv, mw))
+            return jax.lax.scan(step, prev, (xs, zs))
+        return run
 
     ticks = xs.shape[0] - 1
     chunk = min(CHUNK, ticks)
@@ -112,15 +125,24 @@ def bench_tpu(xs, zs):
     )
 
     # warmup chunk (untimed): compiles the scan, and its event density fixes
-    # the D2H slice width for the run
+    # both the device-side word cap and the D2H slice width.  If the
+    # workload (e.g. a Zipfian hotspot) is denser than MAX_WORDS, recompile
+    # with a doubled-headroom cap instead of overflowing every tick.
+    run = make_run(MAX_WORDS)
     wx = jnp.asarray(xs[1:1 + chunk])
     wz = jnp.asarray(zs[1:1 + chunk])
     _wfinal, ((_, _, wne), (_, _, wnl)) = run(wx, wz, prev1)
     peak = int(max(np.asarray(wne).max(), np.asarray(wnl).max()))
-    m = min(MAX_WORDS, max(8192, -(-int(peak * 1.5) // 8192) * 8192))
+    max_words = MAX_WORDS
+    if peak * 1.2 > max_words:
+        max_words = -(-int(peak * 2) // 65536) * 65536
+        run = make_run(max_words)
+        _wfinal, ((_, _, wne), (_, _, wnl)) = run(wx, wz, prev1)
+        peak = int(max(np.asarray(wne).max(), np.asarray(wnl).max()))
+    m = min(max_words, max(8192, -(-int(peak * 1.5) // 8192) * 8192))
     slice_m = jax.jit(lambda a: a[:, :m])
-    jax.block_until_ready(slice_m(jnp.zeros((chunk, MAX_WORDS), jnp.uint32)))
-    jax.block_until_ready(slice_m(jnp.zeros((chunk, MAX_WORDS), jnp.int32)))
+    jax.block_until_ready(slice_m(jnp.zeros((chunk, max_words), jnp.uint32)))
+    jax.block_until_ready(slice_m(jnp.zeros((chunk, max_words), jnp.int32)))
 
     def harvest(ev):
         """Slice one chunk's events to width m and start their D2H."""
@@ -138,8 +160,8 @@ def bench_tpu(xs, zs):
     def finish(harvested):
         (vals_e, idx_e, vals_l, idx_l), ne, nl, ev = harvested
         ne_h, nl_h = np.asarray(ne), np.asarray(nl)
-        stats["overflow"] += int((ne_h > MAX_WORDS).sum()
-                                 + (nl_h > MAX_WORDS).sum())
+        stats["overflow"] += int((ne_h > max_words).sum()
+                                 + (nl_h > max_words).sum())
         # one bulk conversion per array: completes the async copies started
         # in harvest() rather than issuing per-row fetches
         ve_a, ie_a = np.asarray(vals_e), np.asarray(idx_e)
@@ -187,33 +209,45 @@ def bench_tpu(xs, zs):
 
 
 def bench_cpu(xs, zs):
+    """CPU baseline: the native C++ sweep calculator when buildable (the
+    fair equivalent of the reference's compiled go-aoi XZList), else the
+    Python sweep oracle.  Returns (moves_per_sec, kind)."""
+    from goworld_tpu.ops import aoi_native
     from goworld_tpu.ops.aoi_oracle import CPUAOIOracle
 
-    oracles = [CPUAOIOracle(CAP, "sweep") for _ in range(S)]
-    r = np.full(CAP, RADIUS, np.float32)
+    if aoi_native.available():
+        oracles = [aoi_native.NativeAOIOracle(CAP) for _ in range(S)]
+        kind = "cpp-sweep"
+        ticks = min(max(CPU_TICKS, 5), xs.shape[0] - 1)
+    else:
+        oracles = [CPUAOIOracle(CAP, "sweep") for _ in range(S)]
+        kind = "python-sweep"
+        ticks = min(CPU_TICKS, xs.shape[0] - 1)
+    rr = make_radius()
     act = np.ones(CAP, bool)
     for s in range(S):  # prime with frame 0 (untimed; same as the TPU path)
-        oracles[s].step(xs[0, s], zs[0, s], r, act)
-    ticks = min(CPU_TICKS, xs.shape[0] - 1)
+        oracles[s].step(xs[0, s], zs[0, s], rr[s], act)
     t0 = time.perf_counter()
     for t in range(1, ticks + 1):
         for s in range(S):
-            oracles[s].step(xs[t, s], zs[t, s], r, act)
+            oracles[s].step(xs[t, s], zs[t, s], rr[s], act)
     dt = time.perf_counter() - t0
-    return S * CAP * ticks / dt
+    return S * CAP * ticks / dt, kind
 
 
 def main():
     xs, zs = make_walks(TPU_TICKS + 1)
     tpu = bench_tpu(xs, zs)
-    cpu = bench_cpu(xs, zs)
+    cpu, cpu_kind = bench_cpu(xs, zs)
     out = {
         "metric": "aoi_entity_moves_per_sec",
         "value": round(tpu["moves_per_sec"]),
         "unit": "moves/s",
         "vs_baseline": round(tpu["moves_per_sec"] / cpu, 1),
         "config": f"{S} spaces x {CAP} entities, r={RADIUS}, world={WORLD}"
-                  + (", zipf-hotspot" if ZIPF else ""),
+                  + (", zipf-hotspot" if ZIPF else "")
+                  + (", var-radius" if VAR_RADIUS else ""),
+        "cpu_baseline_kind": cpu_kind,
         "tpu_ms_per_tick": round(tpu["ms_per_tick"], 2),
         "tpu_device_ms_per_tick": round(tpu["device_ms_per_tick"], 2),
         "cpu_baseline_moves_per_sec": round(cpu),

@@ -42,7 +42,7 @@ class DispatcherConfig:
 
 @dataclass
 class GameConfig:
-    aoi_backend: str = "cpu"
+    aoi_backend: str = "cpu"  # cpu (python sweep) | cpp (native sweep) | tpu
     tick_interval_ms: int = 5
     position_sync_interval_ms: int = 100
     save_interval_s: int = 300

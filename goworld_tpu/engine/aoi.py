@@ -18,12 +18,14 @@ sharded over the mesh 'space' axis (see goworld_tpu.parallel.mesh); every
 space's [C] rows live wholly on one chip.
 
 Backends:
-  * ``cpu`` -- the XZ-sweep oracle (the reference-equivalent baseline and the
-    parity oracle);
+  * ``cpu`` -- the Python XZ-sweep oracle (the parity oracle);
+  * ``cpp`` -- the native C++ sweep (ops/aoi_native, reference role: the
+    compiled go-aoi XZList) -- the production host-CPU calculator;
   * ``tpu`` -- persistent device-resident interest state per bucket, pallas
     fused kernel, two-stage device event extraction.
 
-Both produce bit-identical events (tests/test_aoi_engine.py).
+All produce bit-identical events (tests/test_aoi_engine.py,
+tests/test_aoi_native.py).
 """
 
 from __future__ import annotations
@@ -66,6 +68,21 @@ class AOIEngine:
         if bucket is None:
             if backend == "cpu":
                 bucket = _CPUBucket(capacity, self.oracle_algorithm)
+            elif backend == "cpp":
+                from ..ops import aoi_native
+
+                if aoi_native.available():
+                    bucket = _CPUBucket(capacity, self.oracle_algorithm,
+                                        oracle_cls=aoi_native.NativeAOIOracle)
+                else:
+                    # LOUD fallback (results are bit-identical, only slower)
+                    from ..utils import gwlog
+
+                    gwlog.logger("gw.aoi").warning(
+                        "libgwaoi.so unavailable (no C++ toolchain?); "
+                        "aoi_backend=cpp falling back to the python oracle"
+                    )
+                    bucket = _CPUBucket(capacity, self.oracle_algorithm)
             elif backend == "tpu":
                 bucket = _TPUBucket(capacity)
             else:
@@ -184,14 +201,22 @@ class _Bucket:
 
 
 class _CPUBucket(_Bucket):
-    def __init__(self, capacity: int, algorithm: str):
+    """Host-side bucket; ``oracle_cls`` picks the python sweep oracle (the
+    parity reference) or the native C++ sweep (ops.aoi_native, the
+    production host calculator -- reference role: go-aoi XZList)."""
+
+    def __init__(self, capacity: int, algorithm: str,
+                 oracle_cls=CPUAOIOracle):
         super().__init__(capacity)
         self.algorithm = algorithm
-        self._oracles: list[CPUAOIOracle] = []
+        self._oracle_cls = oracle_cls
+        self._oracles: list = []
 
     def _grow_to(self, n_slots: int) -> None:
         while len(self._oracles) < n_slots:
-            self._oracles.append(CPUAOIOracle(self.capacity, self.algorithm))
+            self._oracles.append(
+                self._oracle_cls(self.capacity, self.algorithm)
+            )
 
     def _reset_slot(self, slot: int) -> None:
         self._oracles[slot].reset()

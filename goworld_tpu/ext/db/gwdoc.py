@@ -255,7 +255,13 @@ class DocStore:
                 "SELECT data FROM docs WHERE col = ? AND id = ?",
                 (col, str(query["_id"])),
             ).fetchone()
-            return [msgpack.unpackb(row[0], raw=False)] if row else []
+            if row is None:
+                return []
+            doc = msgpack.unpackb(row[0], raw=False)
+            # the row key is str(_id); re-check the real equality so e.g.
+            # querying {'_id': '5'} never hits a doc whose _id is int 5
+            # (find/count, which scan with match(), would not match it)
+            return [doc] if match(doc, query) else []
         hits = [d for d in self._iter(col) if match(d, query)]
         return hits if multi else hits[:1]
 

@@ -56,6 +56,122 @@ class FlateCompressor(Compressor):
         return zlib.decompress(data)
 
 
+class LzmaCompressor(Compressor):
+    """High-ratio/slow codec (role of the reference's lz4 "alternative
+    format" slot, stdlib-backed)."""
+
+    name = "lzma"
+
+    def compress(self, data: bytes) -> bytes:
+        import lzma
+
+        return lzma.compress(data, preset=6)
+
+    def decompress(self, data: bytes) -> bytes:
+        import lzma
+
+        return lzma.decompress(data)
+
+
+class LZWCompressor(Compressor):
+    """LZW (reference: compress.go's compress/lzw entry).  Variable-width
+    codes 9..12 bits MSB-first, dictionary reset at 4096 entries -- the
+    classic GIF/compress scheme, self-contained."""
+
+    name = "lzw"
+    _MAX_CODE = 1 << 12
+
+    def compress(self, data: bytes) -> bytes:
+        # 4-byte LE uncompressed-length header makes the end of stream
+        # exact -- the final byte's padding bits could otherwise decode as a
+        # phantom code
+        if not data:
+            return (0).to_bytes(4, "little")
+        table = {bytes([i]): i for i in range(256)}
+        next_code = 256
+        width = 9
+        out = bytearray()
+        acc = 0
+        nbits = 0
+
+        def emit(code):
+            nonlocal acc, nbits
+            acc = (acc << width) | code
+            nbits += width
+            while nbits >= 8:
+                nbits -= 8
+                out.append((acc >> nbits) & 0xFF)
+
+        cur = b""
+        for b in data:
+            nxt = cur + bytes([b])
+            if nxt in table:
+                cur = nxt
+                continue
+            emit(table[cur])
+            if next_code < self._MAX_CODE:
+                table[nxt] = next_code
+                next_code += 1
+                if next_code > (1 << width) and width < 12:
+                    width += 1
+            else:  # dictionary full: reset (both sides track this)
+                table = {bytes([i]): i for i in range(256)}
+                next_code = 256
+                width = 9
+            cur = bytes([b])
+        emit(table[cur])
+        if nbits:
+            out.append((acc << (8 - nbits)) & 0xFF)
+        return len(data).to_bytes(4, "little") + bytes(out)
+
+    def decompress(self, data: bytes) -> bytes:
+        if len(data) < 4:
+            raise ValueError("truncated lzw stream")
+        n = int.from_bytes(data[:4], "little")
+        table = {i: bytes([i]) for i in range(256)}
+        next_code = 256
+        width = 9
+        acc = 0
+        nbits = 0
+        out = bytearray()
+        prev: bytes | None = None
+        # The decoder's table lags the encoder's by one entry (the classic
+        # LZW lag; code == next_code is the KwKwK case), so its widen check
+        # is ``next_code + 1`` where the encoder's is ``next_code``, and the
+        # table reset fires as soon as the lagged add fills the code space
+        # (the encoder reset before emitting its next code).
+        for byte in data[4:]:
+            if len(out) >= n:
+                break
+            acc = (acc << 8) | byte
+            nbits += 8
+            while nbits >= width and len(out) < n:
+                nbits -= width
+                code = (acc >> nbits) & ((1 << width) - 1)
+                if code in table:
+                    entry = table[code]
+                elif prev is not None and code == next_code:
+                    entry = prev + prev[:1]  # the KwKwK case
+                else:
+                    raise ValueError("corrupt lzw stream")
+                out += entry
+                if prev is not None:
+                    table[next_code] = prev + entry[:1]
+                    next_code += 1
+                    if next_code == self._MAX_CODE:
+                        table = {i: bytes([i]) for i in range(256)}
+                        next_code = 256
+                        width = 9
+                        prev = None
+                        continue
+                    if next_code + 1 > (1 << width) and width < 12:
+                        width += 1
+                prev = entry
+        if len(out) != n:
+            raise ValueError("truncated lzw stream")
+        return bytes(out)
+
+
 def _load_gwlz():
     """Load (building if needed) the native codec; None if unavailable."""
     global _gwlz, _gwlz_tried
@@ -135,6 +251,8 @@ class GwlzCompressor(Compressor):
 _REGISTRY = {
     "none": NoCompressor,
     "flate": FlateCompressor,
+    "lzma": LzmaCompressor,
+    "lzw": LZWCompressor,
     "gwlz": GwlzCompressor,
 }
 

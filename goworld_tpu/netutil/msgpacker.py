@@ -43,6 +43,23 @@ class JSONMsgPacker(MsgPacker):
         return json.loads(raw)
 
 
+class PickleMsgPacker(MsgPacker):
+    """Language-native binary codec (reference role: GobMsgPacker.go --
+    Go-native gob).  ONLY for links where both ends are this framework's
+    own trusted server processes: unpickling attacker-controlled bytes
+    executes code, so this packer must never face clients."""
+
+    def pack(self, obj) -> bytes:
+        import pickle
+
+        return pickle.dumps(obj, protocol=4)
+
+    def unpack(self, raw: bytes):
+        import pickle
+
+        return pickle.loads(raw)
+
+
 def _default(obj):
     # tuples arrive as lists on the far side (same as the reference's
     # msgpack behavior); sets are not wire types

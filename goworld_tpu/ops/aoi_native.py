@@ -1,0 +1,136 @@
+"""Native (C++) XZ-sweep AOI backend.
+
+Role equivalent of the reference's production AOI manager (go-aoi XZList --
+a compiled-language sorted-coordinate sweep, /root/reference/engine/entity/
+Space.go:105): the fast host-CPU calculator for spaces where a device
+round-trip isn't worth it, and the native-speed CPU baseline.  Evaluates the
+exact predicate of :mod:`aoi_predicate`; bit-exact with the Python oracle
+and the TPU backends (tests/test_aoi_native.py).
+
+Loads ``native/libgwaoi.so`` via ctypes, building it with make on first use
+(same scheme as netutil.compress's gwlz loader).  ``available()`` reports
+whether the library could be loaded; callers fall back to the Python oracle.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from . import aoi_predicate as P
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_SO_PATH = os.path.join(_NATIVE_DIR, "libgwaoi.so")
+_lib = None
+_tried = False
+_build_lock = threading.Lock()
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR, "-s", "libgwaoi.so"],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            return None
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.gwaoi_words.restype = None
+        lib.gwaoi_words.argtypes = [f32p, f32p, f32p, u8p, ctypes.c_int32,
+                                    u32p]
+        lib.gwaoi_step.restype = ctypes.c_int64
+        lib.gwaoi_step.argtypes = [
+            f32p, f32p, f32p, u8p, ctypes.c_int32, u32p,
+            i32p, ctypes.c_int64, i32p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+class NativeAOIOracle:
+    """Drop-in for ops.aoi_oracle.CPUAOIOracle, backed by libgwaoi."""
+
+    def __init__(self, capacity: int, _algorithm: str = "sweep"):
+        self.capacity = P.round_capacity(capacity)
+        self.W = P.words_per_row(self.capacity)
+        self.prev_words = np.zeros((self.capacity, self.W), np.uint32)
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(
+                "libgwaoi.so unavailable (no C++ toolchain?); use the "
+                "python oracle backend instead"
+            )
+        # event buffers grow on overflow (-1 return)
+        self._cap_pairs = 4096
+
+    def reset(self) -> None:
+        self.prev_words[:] = 0
+
+    def _padded(self, a, dtype):
+        a = np.ascontiguousarray(a, dtype)
+        if a.shape[0] > self.capacity:
+            raise ValueError(
+                f"{a.shape[0]} entities exceed capacity {self.capacity}"
+            )
+        if a.shape[0] < self.capacity:
+            a = np.concatenate(
+                [a, np.zeros(self.capacity - a.shape[0], dtype)]
+            )
+        return a
+
+    def step(self, x, z, radius, active):
+        """Advance one tick; returns (enter_pairs, leave_pairs) int32 [K, 2],
+        each sorted lexicographically."""
+        x = self._padded(x, np.float32)
+        z = self._padded(z, np.float32)
+        radius = self._padded(radius, np.float32)
+        act = self._padded(np.asarray(active, bool), np.uint8)
+        prev = np.ascontiguousarray(self.prev_words)
+        while True:
+            enter = np.empty((self._cap_pairs, 2), np.int32)
+            leave = np.empty((self._cap_pairs, 2), np.int32)
+            n_leave = ctypes.c_int64(0)
+            ne = self._lib.gwaoi_step(
+                _ptr(x, ctypes.c_float), _ptr(z, ctypes.c_float),
+                _ptr(radius, ctypes.c_float), _ptr(act, ctypes.c_uint8),
+                self.capacity, _ptr(prev, ctypes.c_uint32),
+                _ptr(enter, ctypes.c_int32), self._cap_pairs,
+                _ptr(leave, ctypes.c_int32), self._cap_pairs,
+                ctypes.byref(n_leave),
+            )
+            if ne < 0:
+                self._cap_pairs *= 4
+                continue
+            self.prev_words = prev
+            return enter[:ne].copy(), leave[: n_leave.value].copy()

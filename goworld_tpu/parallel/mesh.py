@@ -65,12 +65,21 @@ class SpaceMesh:
 
 
 def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
-                          block_rows: int = 128):
+                          block_rows: int = 128, max_words: int = 0):
     """Build the multi-chip AOI tick: [S, C] arrays sharded over chips.
 
     S must be a multiple of the mesh size.  Returns a jitted function
     ``step(x, z, r, active, prev) -> (new, enter, leave, total_events)``
     where total_events is a scalar psum over the mesh (the only collective).
+
+    With ``max_words > 0`` each chip also compacts its own diff words
+    (ops/events two-level extraction, chip-local -- event delivery needs no
+    collectives either) and the function returns
+    ``(new, (ent_vals, ent_idx, ent_n), (lv_vals, lv_idx, lv_n), total)``
+    with the per-chip event arrays stacked on the leading axis
+    ([n_dev * max_words] sharded; reshape to [n_dev, max_words]).  Word
+    indices are LOCAL to the chip's space block: global space index =
+    chip * S_local + local_space.
     """
     mesh = space_mesh.mesh
     axis = space_mesh.axis
@@ -78,26 +87,48 @@ def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
     # a cpu mesh under a tpu-default process still needs interpret mode.
     interpret = space_mesh.platform != "tpu"
 
-    def _local(x, z, r, act, prev):
+    def _kernel(x, z, r, act, prev):
         if use_pallas:
-            new, ent, lv = aoi_step_pallas(x, z, r, act, prev,
-                                           block_rows=block_rows,
-                                           interpret=interpret)
-        else:
-            new, ent, lv = aoi_step_dense_batched(x, z, r, act, prev)
+            return aoi_step_pallas(x, z, r, act, prev,
+                                   block_rows=block_rows,
+                                   interpret=interpret)
+        return aoi_step_dense_batched(x, z, r, act, prev)
+
+    def _total(ent, lv):
         local_events = jnp.sum(
             jax.lax.population_count(ent) + jax.lax.population_count(lv),
             dtype=jnp.int32,
         )
-        total = jax.lax.psum(local_events, axis)
-        return new, ent, lv, total
+        return jax.lax.psum(local_events, axis)
 
     spec = PS(axis)
+
+    if not max_words:
+        def _local(x, z, r, act, prev):
+            new, ent, lv = _kernel(x, z, r, act, prev)
+            return new, ent, lv, _total(ent, lv)
+
+        out_specs = (spec, spec, spec, PS())
+    else:
+        from ..ops.events import extract_nonzero_words
+
+        def _local(x, z, r, act, prev):
+            new, ent, lv = _kernel(x, z, r, act, prev)
+            ev, ei, en = extract_nonzero_words(ent, max_words)
+            lv_v, li, ln = extract_nonzero_words(lv, max_words)
+            # counts become [1] so they stack into [n_dev] across the mesh
+            ee = (ev, ei, en.reshape(1))
+            le = (lv_v, li, ln.reshape(1))
+            return new, ee, le, _total(ent, lv)
+
+        ev_spec = (spec, spec, spec)  # vals, idx, count stack per chip
+        out_specs = (spec, ev_spec, ev_spec, PS())
+
     step = jax.shard_map(
         _local,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec),
-        out_specs=(spec, spec, spec, PS()),
+        out_specs=out_specs,
         # pallas_call out_shapes carry no vma annotations; skip the check
         check_vma=False,
     )

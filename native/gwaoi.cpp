@@ -1,0 +1,140 @@
+// gwaoi: native XZ-sweep AOI calculator.
+//
+// Role equivalent of the reference's production AOI data structure (go-aoi
+// XZList, a compiled-language sorted-coordinate sweep --
+// /root/reference/engine/entity/Space.go:105): the fast host-CPU backend for
+// spaces too small to be worth a device round-trip, and the native-speed
+// baseline the TPU path is compared against.
+//
+// Contract (must stay bit-exact with goworld_tpu/ops/aoi_predicate.py):
+//   interested(i, j) := i != j && active[i] && active[j]
+//                       && |x[j] - x[i]| <= r[i]   (float32 ops)
+//                       && |z[j] - z[i]| <= r[i]
+// Packed planar layout: words[i*W + w] bit k == interested(i, k*W + w),
+// W = cap / 32.
+//
+// Sweep: active indices sorted by x; per observer a binary-searched window
+// [x_i - r', x_i + r'] prefilters candidates, where r' is r widened by one
+// float32 ulp and the bounds are evaluated in double (f32-valued doubles are
+// exact) -- the f32-rounded |x_j - x_i| can be <= r while the infinite-
+// precision difference exceeds it by half an ulp, so the window must be
+// conservative.  Every candidate is then re-checked with the exact f32
+// predicate.  Same scheme as the Python oracle's _sweep_interest_matrix.
+//
+// C ABI (ctypes):
+//   void gwaoi_words(const float* x, const float* z, const float* r,
+//                    const uint8_t* active, int32_t cap, uint32_t* out);
+//       out: cap * (cap/32) uint32, fully overwritten.
+//   int64_t gwaoi_step(const float* x, const float* z, const float* r,
+//                      const uint8_t* active, int32_t cap,
+//                      uint32_t* prev,            // [cap*W] in: prev, out: new
+//                      int32_t* enter, int64_t enter_cap,
+//                      int32_t* leave, int64_t leave_cap,
+//                      int64_t* n_leave_out);
+//       Emits (i, j) pairs sorted lexicographically; returns n_enter, or -1
+//       if either pair buffer is too small (prev left unchanged).
+//
+// Build: make -C native (produces libgwaoi.so; loaded via ctypes by
+// goworld_tpu/ops/aoi_native.py).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct SortedX {
+    std::vector<int32_t> order;  // active indices sorted by x
+    std::vector<double> xs;      // their x, as double
+};
+
+void build_sorted(const float* x, const uint8_t* active, int32_t cap,
+                  SortedX& s) {
+    s.order.clear();
+    for (int32_t i = 0; i < cap; ++i)
+        if (active[i]) s.order.push_back(i);
+    std::stable_sort(s.order.begin(), s.order.end(),
+                     [&](int32_t a, int32_t b) { return x[a] < x[b]; });
+    s.xs.resize(s.order.size());
+    for (size_t k = 0; k < s.order.size(); ++k)
+        s.xs[k] = static_cast<double>(x[s.order[k]]);
+}
+
+inline double widened(float r) {
+    return static_cast<double>(r) +
+           static_cast<double>(std::nextafterf(r, INFINITY) - r);
+}
+
+}  // namespace
+
+extern "C" {
+
+void gwaoi_words(const float* x, const float* z, const float* r,
+                 const uint8_t* active, int32_t cap, uint32_t* out) {
+    const int32_t W = cap / 32;
+    std::memset(out, 0, sizeof(uint32_t) * static_cast<size_t>(cap) * W);
+    SortedX s;
+    build_sorted(x, active, cap, s);
+    for (int32_t i = 0; i < cap; ++i) {
+        if (!active[i]) continue;
+        const float xi = x[i], zi = z[i], ri = r[i];
+        const double rw = widened(ri);
+        const double lo = static_cast<double>(xi) - rw;
+        const double hi = static_cast<double>(xi) + rw;
+        auto b = std::lower_bound(s.xs.begin(), s.xs.end(), lo);
+        uint32_t* row = out + static_cast<size_t>(i) * W;
+        for (size_t k = b - s.xs.begin(); k < s.xs.size() && s.xs[k] <= hi;
+             ++k) {
+            const int32_t j = s.order[k];
+            if (j == i) continue;
+            if (std::fabs(x[j] - xi) <= ri && std::fabs(z[j] - zi) <= ri)
+                row[j % W] |= (1u << (j / W));
+        }
+    }
+}
+
+int64_t gwaoi_step(const float* x, const float* z, const float* r,
+                   const uint8_t* active, int32_t cap, uint32_t* prev,
+                   int32_t* enter, int64_t enter_cap, int32_t* leave,
+                   int64_t leave_cap, int64_t* n_leave_out) {
+    const int32_t W = cap / 32;
+    const size_t nw = static_cast<size_t>(cap) * W;
+    std::vector<uint32_t> neww(nw);
+    gwaoi_words(x, z, r, active, cap, neww.data());
+
+    int64_t ne = 0, nl = 0;
+    std::vector<int32_t> row_js;
+    for (int32_t i = 0; i < cap; ++i) {
+        const uint32_t* nr = neww.data() + static_cast<size_t>(i) * W;
+        const uint32_t* pr = prev + static_cast<size_t>(i) * W;
+        for (int pass = 0; pass < 2; ++pass) {
+            row_js.clear();
+            for (int32_t w = 0; w < W; ++w) {
+                uint32_t bits = pass == 0 ? (nr[w] & ~pr[w]) : (pr[w] & ~nr[w]);
+                while (bits) {
+                    const int k = __builtin_ctz(bits);
+                    bits &= bits - 1;
+                    row_js.push_back(k * W + w);
+                }
+            }
+            if (row_js.empty()) continue;
+            std::sort(row_js.begin(), row_js.end());
+            int64_t& n = pass == 0 ? ne : nl;
+            const int64_t capn = pass == 0 ? enter_cap : leave_cap;
+            int32_t* out = pass == 0 ? enter : leave;
+            if (n + static_cast<int64_t>(row_js.size()) > capn) return -1;
+            for (int32_t j : row_js) {
+                out[2 * n] = i;
+                out[2 * n + 1] = j;
+                ++n;
+            }
+        }
+    }
+    std::memcpy(prev, neww.data(), sizeof(uint32_t) * nw);
+    *n_leave_out = nl;
+    return ne;
+}
+
+}  // extern "C"

@@ -1,0 +1,88 @@
+"""Native C++ sweep AOI backend (ops/aoi_native over native/gwaoi.cpp):
+bit-exact parity with the Python oracle, overflow regrowth, engine bucket
+integration (reference role: the compiled-language go-aoi XZList used in
+production)."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.ops import aoi_native
+from goworld_tpu.ops.aoi_oracle import CPUAOIOracle
+
+pytestmark = pytest.mark.skipif(
+    not aoi_native.available(), reason="libgwaoi.so not buildable"
+)
+
+
+def _scenario(seed, cap, n, ticks=6, step=8.0, world=300.0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, world, n).astype(np.float32)
+    z = rng.uniform(0, world, n).astype(np.float32)
+    r = rng.uniform(10, 60, n).astype(np.float32)
+    for t in range(ticks):
+        act = rng.random(n) < 0.85
+        yield x.copy(), z.copy(), r.copy(), act
+        x = (x + rng.uniform(-step, step, n)).astype(np.float32)
+        z = (z + rng.uniform(-step, step, n)).astype(np.float32)
+
+
+@pytest.mark.parametrize("cap,n", [(128, 100), (256, 256), (384, 301)])
+def test_native_matches_python_oracle(cap, n):
+    py = CPUAOIOracle(cap, "sweep")
+    cc = aoi_native.NativeAOIOracle(cap)
+    for x, z, r, act in _scenario(3, cap, n):
+        pe, pl = py.step(x, z, r, act)
+        ce, cl = cc.step(x, z, r, act)
+        np.testing.assert_array_equal(pe, ce)
+        np.testing.assert_array_equal(pl, cl)
+        np.testing.assert_array_equal(py.prev_words, cc.prev_words)
+
+
+def test_native_exact_radius_ties():
+    # |dx| == r exactly must count as interested (float32 ties)
+    cc = aoi_native.NativeAOIOracle(128)
+    py = CPUAOIOracle(128, "sweep")
+    x = np.zeros(4, np.float32)
+    x[1] = 25.0  # dx == r exactly
+    x[2] = np.nextafter(np.float32(25.0), np.float32(100.0))  # just outside
+    x[3] = -25.0
+    z = np.zeros(4, np.float32)
+    r = np.full(4, 25.0, np.float32)
+    act = np.ones(4, bool)
+    pe, _ = py.step(x, z, r, act)
+    ce, _ = cc.step(x, z, r, act)
+    np.testing.assert_array_equal(pe, ce)
+    pairs = {tuple(p) for p in ce}
+    assert (0, 1) in pairs and (0, 3) in pairs and (0, 2) not in pairs
+
+
+def test_native_overflow_regrowth():
+    # everyone sees everyone: n^2 - n events > the initial 4096 pair buffer
+    cap = 128
+    cc = aoi_native.NativeAOIOracle(cap)
+    n = 100
+    x = np.linspace(0, 10, n).astype(np.float32)
+    z = np.zeros(n, np.float32)
+    r = np.full(n, 50.0, np.float32)
+    act = np.ones(n, bool)
+    enter, leave = cc.step(x, z, r, act)
+    assert len(enter) == n * n - n
+    assert len(leave) == 0
+
+
+def test_engine_cpp_backend_matches_cpu():
+    from goworld_tpu.engine.aoi import AOIEngine
+
+    eng_py = AOIEngine("cpu")
+    eng_cc = AOIEngine("cpp")
+    hp = eng_py.create_space(128)
+    hc = eng_cc.create_space(128)
+    for x, z, r, act in _scenario(7, 128, 90):
+        eng_py.submit(hp, x, z, r, act)
+        eng_cc.submit(hc, x, z, r, act)
+        eng_py.flush()
+        eng_cc.flush()
+        pe, pl = eng_py.take_events(hp)
+        ce, cl = eng_cc.take_events(hc)
+        np.testing.assert_array_equal(pe, ce)
+        np.testing.assert_array_equal(pl, cl)

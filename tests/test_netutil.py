@@ -53,7 +53,7 @@ def test_packet_bad_entity_id():
         p.append_entity_id("short")
 
 
-@pytest.mark.parametrize("fmt", ["none", "flate", "gwlz"])
+@pytest.mark.parametrize("fmt", ["none", "flate", "lzma", "lzw", "gwlz"])
 def test_compressor_roundtrip(fmt):
     c = new_compressor(fmt)
     rng = random.Random(0)
@@ -63,8 +63,25 @@ def test_compressor_roundtrip(fmt):
         assert c.decompress(c.compress(data)) == data
 
 
+def test_lzw_hard_cases():
+    # dictionary resets (incompressible data fills the 4096-entry table
+    # fast), the KwKwK pattern, and width-boundary sizes
+    c = new_compressor("lzw")
+    rng = random.Random(1)
+    for data in (
+        bytes(rng.randrange(256) for _ in range(64 * 1024)),  # many resets
+        b"ab" * 20000,                                         # KwKwK chains
+        bytes(rng.choices(range(4), k=100000)),                # deep table
+        b"",
+        b"x",
+    ):
+        assert c.decompress(c.compress(data)) == data
+
+
 def test_msgpackers():
-    for packer in (MessagePackMsgPacker(), JSONMsgPacker()):
+    from goworld_tpu.netutil.msgpacker import PickleMsgPacker
+
+    for packer in (MessagePackMsgPacker(), JSONMsgPacker(), PickleMsgPacker()):
         obj = {"a": 1, "b": [1.5, "x", None], "c": {"d": True}}
         assert packer.unpack(packer.pack(obj)) == obj
     # tuples become lists on the wire (documented)

@@ -48,3 +48,67 @@ def test_sharded_step_matches_single_device():
         )
     )
     assert int(total) == expect and expect > 0
+
+
+def test_sharded_step_with_chip_local_extraction():
+    """max_words mode: each chip compacts its own diff words; per-chip event
+    sets must equal the single-device extraction of that chip's space block
+    (chip-local indices, zero collectives in the event path)."""
+    import jax.numpy as jnp
+
+    from goworld_tpu.ops import round_capacity, words_per_row
+    from goworld_tpu.ops.aoi_dense import aoi_step_dense_batched
+    from goworld_tpu.ops.events import expand_words_host
+    from goworld_tpu.parallel import SpaceMesh, make_sharded_aoi_step, multichip_devices
+
+    devices = multichip_devices(8)
+    n_dev = len(devices)
+    cap = round_capacity(128)
+    w = words_per_row(cap)
+    S, MW = 16, 4096
+    s_loc = S // n_dev
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 300, (S, cap)).astype(np.float32)
+    z = rng.uniform(0, 300, (S, cap)).astype(np.float32)
+    r = np.full((S, cap), 30, np.float32)
+    act = rng.random((S, cap)) < 0.8
+    prev = np.zeros((S, cap, w), np.uint32)
+
+    sm = SpaceMesh(devices)
+    step = make_sharded_aoi_step(sm, use_pallas=True, max_words=MW)
+    new, (ev, ei, en), (lvv, li, ln), total = step(
+        sm.device_put(x), sm.device_put(z), sm.device_put(r),
+        sm.device_put(act), sm.device_put(prev),
+    )
+    ev = np.asarray(ev).reshape(n_dev, MW)
+    ei = np.asarray(ei).reshape(n_dev, MW)
+    en = np.asarray(en)
+    assert en.shape == (n_dev,)
+
+    _nd, ed, _ld = aoi_step_dense_batched(
+        jnp.asarray(x), jnp.asarray(z), jnp.asarray(r), jnp.asarray(act),
+        jnp.asarray(prev),
+    )
+    ed = np.asarray(ed)
+    all_pairs = []
+    for chip in range(n_dev):
+        # expand this chip's events with LOCAL space indices, then offset
+        want_words = ed[chip * s_loc:(chip + 1) * s_loc]
+        assert int(en[chip]) == int(np.count_nonzero(want_words))
+        pairs = expand_words_host(ev[chip], ei[chip], cap, s_loc)
+        pairs = pairs.copy()
+        pairs[:, 0] += chip * s_loc
+        all_pairs.append(pairs)
+    got = {tuple(p) for p in np.concatenate(all_pairs)}
+    # oracle: every set bit of the dense enter mask, as (space, i, j)
+    s_idx, i_idx, w_idx = np.nonzero(ed)
+    want = set()
+    for s_i, i, wd in zip(s_idx, i_idx, w_idx):
+        bits = int(ed[s_i, i, wd])
+        k = 0
+        while bits:
+            if bits & 1:
+                want.add((s_i, i, k * w + wd))
+            bits >>= 1
+            k += 1
+    assert got == want and len(want) > 0
