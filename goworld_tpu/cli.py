@@ -34,12 +34,30 @@ def _logfile(rundir: str, name: str) -> str:
     return os.path.join(rundir, f"{name}.log")
 
 
-def _alive(pid: int) -> bool:
+def _proc_cmdline(pid: int) -> str:
+    """The process's command line via /proc (reference role:
+    cmd/goworld/process -- process-table inspection so a stale pidfile whose
+    pid was recycled by an unrelated process is not reported RUNNING)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _alive(pid: int, name: str | None = None) -> bool:
     try:
         os.kill(pid, 0)
-        return True
     except (ProcessLookupError, PermissionError):
         return False
+    if name is None or not os.path.isdir("/proc"):
+        return True
+    # the component named e.g. "game2" runs as
+    # `python -m goworld_tpu.components.game`; verify the pid still belongs
+    # to that component kind (pid-recycling guard).  An empty cmdline
+    # (zombie / kernel thread) is not our live component.
+    kind = name.rstrip("0123456789")
+    return f"goworld_tpu.components.{kind}" in _proc_cmdline(pid)
 
 
 def _read_pids(rundir: str) -> dict[str, int]:
@@ -148,13 +166,13 @@ def _signal_kind(rundir: str, prefix: str, sig, wait: float = 10.0) -> list[str]
     pids = _read_pids(rundir)
     names = [n for n in pids if n.startswith(prefix)]
     for n in names:
-        if _alive(pids[n]):
+        if _alive(pids[n], n):
             os.kill(pids[n], sig)
     deadline = time.time() + wait
-    while time.time() < deadline and any(_alive(pids[n]) for n in names):
+    while time.time() < deadline and any(_alive(pids[n], n) for n in names):
         time.sleep(0.05)
     for n in names:
-        if not _alive(pids[n]):
+        if not _alive(pids[n], n):
             try:
                 os.unlink(_pidfile(rundir, n))
             except OSError:
@@ -173,7 +191,7 @@ def cmd_stop(args) -> int:
 
 def cmd_kill(args) -> int:
     for name, pid in _read_pids(args.dir).items():
-        if _alive(pid):
+        if _alive(pid, name):
             os.kill(pid, signal.SIGKILL)
     print("cluster killed")
     return 0
@@ -186,7 +204,7 @@ def cmd_status(args) -> int:
         return 1
     rc = 0
     for name, pid in sorted(pids.items()):
-        ok = _alive(pid)
+        ok = _alive(pid, name)
         print(f"{name:16s} pid={pid:<8d} {'RUNNING' if ok else 'DEAD'}")
         rc |= 0 if ok else 1
     return rc
@@ -199,12 +217,12 @@ def cmd_reload(args) -> int:
     pids = _read_pids(args.dir)
     game_names = [f"game{i}" for i in cfg.games if f"game{i}" in pids]
     for n in game_names:
-        if _alive(pids[n]):
+        if _alive(pids[n], n):
             os.kill(pids[n], signal.SIGHUP)
     deadline = time.time() + 30
-    while time.time() < deadline and any(_alive(pids[n]) for n in game_names):
+    while time.time() < deadline and any(_alive(pids[n], n) for n in game_names):
         time.sleep(0.05)
-    still = [n for n in game_names if _alive(pids[n])]
+    still = [n for n in game_names if _alive(pids[n], n)]
     if still:
         print(f"games did not freeze: {still}", file=sys.stderr)
         return 1

@@ -91,6 +91,7 @@ class DispatcherService:
         self.gates: dict[int, _Peer] = {}
         self.entities: dict[str, _EntityInfo] = {}
         self.srvdis: dict[str, str] = {}
+        self._srvdis_owner: dict[str, int] = {}  # srvid -> registering game
         self.ready = False
         self._blocked_eids: set[str] = set()  # entities with block/pending state
         self._boot_rr = 0
@@ -198,6 +199,10 @@ class DispatcherService:
             gi.frozen = False
             self._unblock_game(gi)
         self.log.info("game%d connected (%d entities, restore=%s)", gid, n, is_restore)
+        # srvdis snapshot: a (re)connecting game must learn registrations it
+        # missed (reference: service-map-on-connect, GoWorldConnection.go:404-423)
+        for srvid, info in self.srvdis.items():
+            peer.send(self._srvdis_update_pkt(srvid, info))
         self._drain_pending_boots()
         self._check_ready()
 
@@ -399,22 +404,29 @@ class DispatcherService:
             self._unblock_entity(eid, ei)
 
     # -- srvdis ------------------------------------------------------------
+    @staticmethod
+    def _srvdis_update_pkt(srvid: str, info: str) -> Packet:
+        out = Packet.for_msgtype(MT.MT_SRVDIS_UPDATE)
+        out.append_varstr(srvid)
+        out.append_varstr(info)
+        return out
+
     def _h_srvdis_register(self, peer, pkt):
         srvid = pkt.read_varstr()
         info = pkt.read_varstr()
         force = pkt.read_bool()
+        if not info:
+            # empty info is the deregistration sentinel on the update wire;
+            # storing it would desync dispatcher and games permanently
+            self.log.warning("rejecting empty srvdis registration for %s", srvid)
+            return
         if force or srvid not in self.srvdis:
             self.srvdis[srvid] = info  # first-writer-wins (reference :737-751)
-            out = Packet.for_msgtype(MT.MT_SRVDIS_UPDATE)
-            out.append_varstr(srvid)
-            out.append_varstr(self.srvdis[srvid])
-            self._broadcast_games(out)
+            self._srvdis_owner[srvid] = peer.id
+            self._broadcast_games(self._srvdis_update_pkt(srvid, info))
         else:
             # already registered: send current registration back to requester
-            out = Packet.for_msgtype(MT.MT_SRVDIS_UPDATE)
-            out.append_varstr(srvid)
-            out.append_varstr(self.srvdis[srvid])
-            peer.send(out)
+            peer.send(self._srvdis_update_pkt(srvid, self.srvdis[srvid]))
 
     # -- freeze ------------------------------------------------------------
     def _h_start_freeze_game(self, peer, pkt):
@@ -517,7 +529,21 @@ class DispatcherService:
                 out = Packet.for_msgtype(MT.MT_NOTIFY_GAME_DISCONNECTED)
                 out.append_u16(peer.id)
                 self._broadcast_games(out, exclude=peer.id)
-                self.log.info("game%d disconnected (%d entities dropped)", peer.id, len(dead))
+                # purge the dead game's service registrations and broadcast
+                # the deregistration (empty info) so survivors re-claim --
+                # cluster-singleton failover
+                stale = [s for s, g in self._srvdis_owner.items()
+                         if g == peer.id]
+                for srvid in stale:
+                    del self._srvdis_owner[srvid]
+                    self.srvdis.pop(srvid, None)
+                    self._broadcast_games(
+                        self._srvdis_update_pkt(srvid, ""), exclude=peer.id
+                    )
+                self.log.info(
+                    "game%d disconnected (%d entities dropped, %d services released)",
+                    peer.id, len(dead), len(stale),
+                )
         elif peer.kind == "gate":
             if self.gates.get(peer.id) is peer:
                 del self.gates[peer.id]

@@ -320,7 +320,10 @@ class GameService:
     def _h_srvdis_update(self, pkt):
         srvid = pkt.read_varstr()
         info = pkt.read_varstr()
-        self.srvmap[srvid] = info
+        if info:
+            self.srvmap[srvid] = info
+        else:  # deregistration (provider game died): open for re-claim
+            self.srvmap.pop(srvid, None)
         if self.on_srvdis_update is not None:
             gwutils.run_panicless(self.on_srvdis_update, srvid, info, logger=self.log)
 

@@ -186,7 +186,11 @@ class GateService:
         sync_s = self.gatecfg.position_sync_interval_ms / 1000.0
         flush_deadline = time.monotonic() + 0.005
         next_sync = time.monotonic() + sync_s
-        next_hb_check = time.monotonic() + 5.0
+        # check at least twice per timeout window so short timeouts kick
+        # promptly (the default stays one sweep per 5 s)
+        hb_timeout = self.gatecfg.heartbeat_timeout_s
+        hb_interval = min(5.0, max(0.25, hb_timeout / 2)) if hb_timeout > 0 else 5.0
+        next_hb_check = time.monotonic() + hb_interval
         while not self._stop.is_set():
             timeout = max(0.0, flush_deadline - time.monotonic())
             try:
@@ -206,7 +210,7 @@ class GateService:
                 flush_deadline = now + 0.005
             if now >= next_hb_check:
                 self._kick_dead_clients(now)
-                next_hb_check = now + 5.0
+                next_hb_check = now + hb_interval
 
     def _dispatch(self, kind, a, b):
         if kind == "client_pkt":

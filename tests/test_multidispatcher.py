@@ -1,0 +1,141 @@
+"""Multi-dispatcher sharding (reference: engine/dispatchercluster -- N
+dispatchers, every game/gate connects to each, traffic hash-sharded by
+entity/gate/srvid so per-entity ordering holds within its shard;
+DispatcherService.go routing state is per-shard)."""
+
+import time
+
+import pytest
+
+from goworld_tpu import config as gwconfig
+from goworld_tpu.client import GameClientConnection
+from goworld_tpu.components.dispatcher.service import DispatcherService
+from goworld_tpu.components.game.service import GameService
+from goworld_tpu.components.gate.service import GateService
+from goworld_tpu.dispatchercluster import entity_shard
+from goworld_tpu.engine.entity import Entity
+from goworld_tpu.engine.rpc import OWN_CLIENT, rpc
+
+CONFIG = """
+[deployment]
+dispatchers = 2
+games = 2
+gates = 1
+
+[dispatcher1]
+port = 0
+
+[dispatcher2]
+port = 0
+
+[game_common]
+boot_entity = ShardAvatar
+aoi_backend = cpu
+
+[gate1]
+port = 0
+heartbeat_timeout_s = 0
+"""
+
+
+class ShardAvatar(Entity):
+    @rpc(expose=OWN_CLIENT)
+    def ping(self, token):
+        self.call_client("pong", token)
+
+    @rpc
+    def poke(self, from_eid):
+        game = self._runtime().game
+        game.call_entity(from_eid, "poked", self.id)
+
+    @rpc
+    def poked(self, by_eid):
+        self.attrs.set("poked_by", by_eid)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    cfg = gwconfig.loads(CONFIG)
+    disps = []
+    for i in (1, 2):
+        d = DispatcherService(i, cfg).start()
+        cfg.dispatchers[i].host, cfg.dispatchers[i].port = d.addr
+        disps.append(d)
+    games = []
+    for gid in (1, 2):
+        gs = GameService(gid, cfg, freeze_dir=str(tmp_path))
+        gs.register_entity_type(ShardAvatar)
+        gs.start()
+        games.append(gs)
+    gate = GateService(1, cfg).start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not all(
+        g.deployment_ready for g in games
+    ):
+        time.sleep(0.01)
+    assert all(g.deployment_ready for g in games)
+    yield disps, games, gate
+    gate.stop()
+    for g in games:
+        g.stop()
+    for d in disps:
+        d.stop()
+
+
+def test_traffic_spans_both_dispatcher_shards(cluster):
+    disps, games, gate = cluster
+
+    # connect clients until boot entities cover both shards (ids are random,
+    # so a handful of clients is plenty)
+    clients = []
+    shards = set()
+    for _ in range(8):
+        c = GameClientConnection(gate.addr)
+        assert c.wait_for(lambda c: c.player is not None, 10)
+        clients.append(c)
+        shards.add(entity_shard(c.player.id, 2))
+        if len(shards) == 2 and len(clients) >= 4:
+            break
+    assert shards == {0, 1}, "entity ids never spanned both shards"
+
+    # client -> entity RPC works regardless of which shard the entity is on
+    for i, c in enumerate(clients):
+        c.call_player("ping", f"tok{i}")
+    for i, c in enumerate(clients):
+        assert c.wait_for(
+            lambda c, i=i: ("pong", (f"tok{i}",)) in c.player.calls, 10
+        ), f"client {i} never got pong (shard {entity_shard(c.player.id, 2)})"
+
+    # entity -> entity RPC across games AND shards: every avatar pokes every
+    # other avatar; each poke crosses the poked entity's own dispatcher shard
+    eids = [c.player.id for c in clients]
+    all_games = {g.rt.entities.get(e): g for g in games for e in eids
+                 if g.rt.entities.get(e) is not None}
+    assert len(all_games) == len(eids)
+    g1 = games[0]
+    for a in eids:
+        for b in eids:
+            if a != b:
+                g1.call_entity(b, "poke", a)
+    deadline = time.monotonic() + 10
+
+    def poked_count():
+        n = 0
+        for g in games:
+            for e in eids:
+                ent = g.rt.entities.get(e)
+                if ent is not None and ent.attrs.get("poked_by"):
+                    n += 1
+        return n
+
+    while time.monotonic() < deadline and poked_count() < len(eids):
+        time.sleep(0.02)
+    assert poked_count() == len(eids)
+
+    # both dispatchers actually carried entity traffic (directory non-empty)
+    for d in disps:
+        owned = [e for e in eids if entity_shard(e, 2) == d.id - 1]
+        for e in owned:
+            assert e in d.entities, f"dispatcher{d.id} missing {e}"
+    for c in clients:
+        c.close()
