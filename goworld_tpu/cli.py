@@ -266,7 +266,9 @@ def cmd_build(args) -> int:
                   file=sys.stderr)
             ok = False
         else:
-            print(f"native: {os.path.join(native_dir, 'libgwlz.so')}")
+            libs = [f for f in sorted(os.listdir(native_dir))
+                    if f.endswith(".so")]
+            print(f"native: {', '.join(libs)} in {native_dir}")
     # 2. byte-compile the framework package
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     if not compileall.compile_dir(pkg_dir, quiet=2, force=False):

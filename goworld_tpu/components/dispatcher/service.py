@@ -24,16 +24,20 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from ... import consts
 from ...config import ClusterConfig
 from ...netutil import Packet, PacketConnection, serve_tcp
 from ...proto import msgtypes as MT
-from ...utils import binutil, gwlog, gwvar
+from ...utils import binutil, gwlog, gwvar, opmon
 
-BLOCKED_ENTITY_QUEUE_MAX = 1000      # reference: consts.go:32
-BLOCKED_GAME_QUEUE_MAX = 1_000_000   # reference: consts.go:30
-MIGRATE_BLOCK_TIMEOUT = 60.0
-LOAD_BLOCK_TIMEOUT = 10.0
-FREEZE_BLOCK_TIMEOUT = 10.0
+from ...consts import (  # noqa: F401  (module aliases kept for callers)
+    BLOCKED_ENTITY_QUEUE_MAX,
+    BLOCKED_GAME_QUEUE_MAX,
+    COMPONENT_QUEUE_MAX,
+    FREEZE_BLOCK_TIMEOUT,
+    LOAD_BLOCK_TIMEOUT,
+    MIGRATE_BLOCK_TIMEOUT,
+)
 
 
 @dataclass
@@ -86,7 +90,7 @@ class DispatcherService:
         dc = cfg.dispatchers[disp_id]
         self.dispcfg = dc
         self.addr = (dc.host, dc.port)
-        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=100000)
+        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=COMPONENT_QUEUE_MAX)
         self.games: dict[int, _GameInfo] = {}
         self.gates: dict[int, _Peer] = {}
         self.entities: dict[str, _EntityInfo] = {}
@@ -110,6 +114,7 @@ class DispatcherService:
             binutil.setup_http_server(self.dispcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S, self.log)
         self.log.info("dispatcher listening on %s", self.addr)
         return self
 
@@ -200,9 +205,16 @@ class DispatcherService:
             self._unblock_game(gi)
         self.log.info("game%d connected (%d entities, restore=%s)", gid, n, is_restore)
         # srvdis snapshot: a (re)connecting game must learn registrations it
-        # missed (reference: service-map-on-connect, GoWorldConnection.go:404-423)
+        # missed AND drop stale ones purged while it was away (its provider
+        # entry may have been released to another game) -- sent even when
+        # empty so the game prunes this shard's entries
+        # (reference: service-map-on-connect, GoWorldConnection.go:404-423)
+        snap = Packet.for_msgtype(MT.MT_SRVDIS_SNAPSHOT)
+        snap.append_u32(len(self.srvdis))
         for srvid, info in self.srvdis.items():
-            peer.send(self._srvdis_update_pkt(srvid, info))
+            snap.append_varstr(srvid)
+            snap.append_varstr(info)
+        peer.send(snap)
         self._drain_pending_boots()
         self._check_ready()
 

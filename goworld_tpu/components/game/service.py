@@ -19,7 +19,9 @@ import queue
 import threading
 import time
 
+from ... import consts
 from ...config import ClusterConfig
+from ...consts import COMPONENT_QUEUE_MAX
 from ...dispatchercluster import DispatcherCluster
 from ...engine.entity import Entity, GameClient
 from ...engine.ids import fixed_id, gen_id
@@ -29,7 +31,7 @@ from ...engine.vector import Vector3
 from ...netutil import Packet
 from ...proto import GWConnection, msgtypes as MT
 from ...utils.asyncjobs import JobError
-from ...utils import binutil, gwlog, gwutils, gwvar
+from ...utils import binutil, gwlog, gwutils, gwvar, opmon
 from .lbc import LoadReporter
 
 
@@ -52,7 +54,7 @@ class GameService:
         self.rt.on_entity_registered = self._on_entity_registered
         self.rt.on_entity_unregistered = self._on_entity_unregistered
         self.rt.game = self  # entities reach cluster ops through this
-        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=100000)
+        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=COMPONENT_QUEUE_MAX)
         self.cluster = DispatcherCluster(
             cfg.dispatcher_addrs(),
             on_packet=lambda i, p: self.queue.put((i, p)),
@@ -117,6 +119,7 @@ class GameService:
             binutil.setup_http_server(self.gcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S, self.log)
         gwlog.announce_ready(f"game{self.id}", "game")
         return self
 
@@ -162,7 +165,7 @@ class GameService:
             timeout = max(0.0, next_tick - time.monotonic())
             try:
                 i, pkt = self.queue.get(timeout=timeout)
-                gwutils.run_panicless(self._handle, pkt, logger=self.log)
+                gwutils.run_panicless(self._handle, pkt, i, logger=self.log)
             except queue.Empty:
                 pass
             now = time.monotonic()
@@ -199,15 +202,18 @@ class GameService:
                     i, pkt = self.queue.get_nowait()
                 except queue.Empty:
                     break
-                gwutils.run_panicless(self._handle, pkt, logger=self.log)
+                gwutils.run_panicless(self._handle, pkt, i, logger=self.log)
             self.rt.tick()
             self._drain_client_outboxes()
             self._send_position_syncs()
             self.cluster.flush_all()
 
     # -- inbound handlers --------------------------------------------------
-    def _handle(self, pkt: Packet):
+    def _handle(self, pkt: Packet, disp_index: int = 0):
         msgtype = pkt.read_u16()
+        if msgtype == MT.MT_SRVDIS_SNAPSHOT:
+            self._apply_srvdis_snapshot(disp_index, pkt)
+            return
         h = self._HANDLERS.get(msgtype)
         if h is None:
             self.log.warning("unhandled msgtype %d", msgtype)
@@ -316,6 +322,34 @@ class GameService:
             if self.rt.entities.get(eid) is None:
                 self.rt.entities.create(type_name, eid=eid, attrs=data or {})
         storage.load(type_name, eid, on_loaded)
+
+    def _apply_srvdis_snapshot(self, disp_index: int, pkt: Packet):
+        """Replace this dispatcher shard's slice of the service map with the
+        snapshot: prune entries the dispatcher no longer has (released while
+        our link was down -- keeping them would let a stale provider believe
+        it still owns a singleton), then apply the rest."""
+        from ...dispatchercluster import srvid_shard
+
+        n_disp = len(self.cluster.addrs)
+        count = pkt.read_u32()
+        snap = {}
+        for _ in range(count):
+            srvid = pkt.read_varstr()
+            snap[srvid] = pkt.read_varstr()
+        changed = []
+        for srvid in list(self.srvmap):
+            if srvid_shard(srvid, n_disp) == disp_index and srvid not in snap:
+                del self.srvmap[srvid]
+                changed.append((srvid, ""))
+        for srvid, info in snap.items():
+            if self.srvmap.get(srvid) != info:
+                self.srvmap[srvid] = info
+                changed.append((srvid, info))
+        if self.on_srvdis_update is not None:
+            for srvid, info in changed:
+                gwutils.run_panicless(
+                    self.on_srvdis_update, srvid, info, logger=self.log
+                )
 
     def _h_srvdis_update(self, pkt):
         srvid = pkt.read_varstr()

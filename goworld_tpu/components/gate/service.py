@@ -21,12 +21,14 @@ import ssl
 import threading
 import time
 
+from ... import consts
 from ...config import ClusterConfig
+from ...consts import COMPONENT_QUEUE_MAX
 from ...dispatchercluster import DispatcherCluster
 from ...engine.ids import gen_id
 from ...netutil import Packet, PacketConnection, kcp, serve_tcp, websocket
 from ...proto import GWConnection, msgtypes as MT
-from ...utils import binutil, gwlog, gwutils, gwvar
+from ...utils import binutil, gwlog, gwutils, gwvar, opmon
 from .filtertree import FilterTree
 
 
@@ -68,7 +70,7 @@ class GateService:
         self.cfg = cfg
         self.gatecfg = cfg.gates[gate_id]
         self.log = gwlog.logger(f"gate{gate_id}")
-        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=100000)
+        self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=COMPONENT_QUEUE_MAX)
         self.clients: dict[str, ClientProxy] = {}
         self.filter_trees: dict[str, FilterTree] = {}
         self.cluster = DispatcherCluster(
@@ -130,6 +132,7 @@ class GateService:
             )
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S, self.log)
         gwlog.announce_ready(f"gate{self.id}", "gate")
         self.log.info("gate listening on %s", self.addr)
         return self
@@ -214,7 +217,14 @@ class GateService:
 
     def _dispatch(self, kind, a, b):
         if kind == "client_pkt":
-            self._handle_client_packet(a, b)
+            # slow-op warning at 100 ms (reference: GateService.go:433-440);
+            # finally: the slow/broken packets are exactly the ones the
+            # stats must not miss
+            op = opmon.Operation("gate.client_pkt")
+            try:
+                self._handle_client_packet(a, b)
+            finally:
+                op.finish(0.1, self.log)
         elif kind == "disp":
             self._handle_dispatcher_packet(b)
         elif kind == "client_new":

@@ -32,6 +32,8 @@ from __future__ import annotations
 import configparser
 from dataclasses import dataclass, field
 
+from . import consts
+
 
 @dataclass
 class DispatcherConfig:
@@ -43,9 +45,9 @@ class DispatcherConfig:
 @dataclass
 class GameConfig:
     aoi_backend: str = "cpu"  # cpu (python sweep) | cpp (native sweep) | tpu
-    tick_interval_ms: int = 5
-    position_sync_interval_ms: int = 100
-    save_interval_s: int = 300
+    tick_interval_ms: int = consts.TICK_INTERVAL_MS
+    position_sync_interval_ms: int = consts.POSITION_SYNC_INTERVAL_MS
+    save_interval_s: int = consts.ENTITY_SAVE_INTERVAL_S
     boot_entity: str = ""
     log_file: str = ""
     http_port: int = 0
@@ -59,7 +61,7 @@ class GateConfig:
     kcp_port: int = 0
     compression: str = "gwlz"
     heartbeat_timeout_s: float = 30.0
-    position_sync_interval_ms: int = 100
+    position_sync_interval_ms: int = consts.POSITION_SYNC_INTERVAL_MS
     log_file: str = ""
     http_port: int = 0
     # both set -> TLS on the TCP and WebSocket listeners (reference:

@@ -2,33 +2,18 @@
 
 Reference role: engine/consts/consts.go:6-137 -- the single module holding
 every engine constant (tick intervals, queue bounds, buffer sizes,
-compression threshold, block timeouts, debug flags).  Deployment-varying
-values live in goworld.ini (see config.py); the values here are the
-engine's fixed contract, re-exported from the modules that own them so each
-stays defined next to the code it governs while remaining discoverable (and
-greppable) from one import:
-
-    from goworld_tpu import consts
+compression threshold, block timeouts).  This module imports nothing from
+the engine, so every other module can import it; deployment-varying values
+live in goworld.ini (config.py), whose defaults also come from here.
 """
 
 from __future__ import annotations
 
-# wire protocol (netutil)
-from .netutil.packet import MAX_PACKET_SIZE  # noqa: F401  25 MiB
-from .netutil.conn import COMPRESS_THRESHOLD  # noqa: F401  512 B
+# wire protocol
+MAX_PACKET_SIZE = 25 * 1024 * 1024  # reference: PacketConnection.go:24
+COMPRESS_THRESHOLD = 512  # compress payloads >= this (reference: consts.go:20)
 
-# dispatcher block/replay state machine
-from .components.dispatcher.service import (  # noqa: F401
-    BLOCKED_ENTITY_QUEUE_MAX,  # 1000 pkts per loading/migrating entity
-    BLOCKED_GAME_QUEUE_MAX,  # 1M pkts per frozen game
-    MIGRATE_BLOCK_TIMEOUT,  # 60 s
-    LOAD_BLOCK_TIMEOUT,  # 10 s
-    FREEZE_BLOCK_TIMEOUT,  # 10 s
-)
-
-# main-loop cadence (reference: consts.go:36-66 -- 5 ms ticks/flushes; the
-# per-process values are configurable via [game_common] etc., these are the
-# engine defaults)
+# main-loop cadence (reference: consts.go:36-66)
 TICK_INTERVAL_MS = 5
 FLUSH_INTERVAL_MS = 5
 POSITION_SYNC_INTERVAL_MS = 100
@@ -37,8 +22,18 @@ POSITION_SYNC_INTERVAL_MS = 100
 # 10x here since the python processes drain in batches)
 COMPONENT_QUEUE_MAX = 100_000
 
+# dispatcher block/replay state machine
+BLOCKED_ENTITY_QUEUE_MAX = 1000      # reference: consts.go:32
+BLOCKED_GAME_QUEUE_MAX = 1_000_000   # reference: consts.go:30
+MIGRATE_BLOCK_TIMEOUT = 60.0         # reference: consts.go:71-77
+LOAD_BLOCK_TIMEOUT = 10.0
+FREEZE_BLOCK_TIMEOUT = 10.0
+
 # persistence
 ENTITY_SAVE_INTERVAL_S = 300  # reference: read_config.go:28 (5 min)
+
+# ops
+OPMON_DUMP_INTERVAL_S = 60.0  # periodic op-table log (reference: opmon.go:26-35)
 
 # AOI
 DEFAULT_AOI_DISTANCE = 100.0  # reference: unity_demo/MySpace.go:26

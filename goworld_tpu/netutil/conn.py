@@ -19,12 +19,13 @@ import socket
 import struct
 import threading
 
+from ..utils import opmon
 from .compress import Compressor, new_compressor
 from .packet import MAX_PACKET_SIZE, Packet
 
 _COMPRESSED_BIT = 0x80000000
 _SIZE_MASK = 0x7FFFFFFF
-COMPRESS_THRESHOLD = 512  # reference: consts.go:20
+from ..consts import COMPRESS_THRESHOLD  # noqa: F401  (re-export; 512 B)
 _u32 = struct.Struct("<I")
 
 
@@ -93,6 +94,7 @@ class PacketConnection:
                 batch, self._pending = self._pending, []
             if not batch:
                 return 0
+            op = opmon.Operation("conn.flush")
             out = bytearray()
             for payload in batch:
                 if self._threshold and len(payload) >= self._threshold:
@@ -103,7 +105,10 @@ class PacketConnection:
                         continue
                 out += _u32.pack(len(payload))
                 out += payload
-            self._sock.sendall(out)
+            try:
+                self._sock.sendall(out)
+            finally:
+                op.finish()
             return len(out)
 
     # -- recv side ---------------------------------------------------------

@@ -19,7 +19,7 @@ import threading
 
 from ..engine.ids import ID_LENGTH
 
-MAX_PACKET_SIZE = 25 * 1024 * 1024  # reference: PacketConnection.go:24
+from ..consts import MAX_PACKET_SIZE  # noqa: F401  (re-export; 25 MiB)
 _POOL_CLASSES = (256, 1024, 8192, 65536, 1 << 20)
 _POOL_MAX_EACH = 256
 

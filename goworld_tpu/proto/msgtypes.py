@@ -38,7 +38,9 @@ MT_CANCEL_MIGRATE = 33
 
 # -- service discovery -----------------------------------------------------
 MT_SRVDIS_REGISTER = 40  # game -> disp: srvid, info
-MT_SRVDIS_UPDATE = 41    # disp -> games: srvid, info (registry delta)
+MT_SRVDIS_UPDATE = 41    # disp -> games: srvid, info ("" = deregistered)
+MT_SRVDIS_SNAPSHOT = 42  # disp -> one game on connect: full shard registry;
+                         # the game prunes its entries for that shard first
 
 # -- freeze / hot reload ---------------------------------------------------
 MT_START_FREEZE_GAME = 50      # game -> disp

@@ -68,18 +68,34 @@ class ServiceManager:
                     )
                 continue
             game_id, eid = self._parse(info)
-            local = self.game.rt.entities.get(eid)
-            if game_id == self.game.id and local is None:
+            # every local instance of the type that is NOT the registered
+            # one is a stray (e.g. a stale claim kept through a dispatcher
+            # link drop) and must go -- matching only the registered eid
+            # would leave strays with other ids alive forever
+            strays = [
+                e for e in list(self.game.rt.entities.entities.values())
+                if e.type_name == type_name
+                and not (game_id == self.game.id and e.id == eid)
+            ]
+            for e in strays:
+                self.log.info("destroying duplicate service %s (%s)",
+                              type_name, e.id)
+                e.destroy()
+            if game_id == self.game.id and self.game.rt.entities.get(eid) is None:
                 self._instantiate(type_name, eid)
-            elif game_id != self.game.id and local is not None:
-                self.log.info("destroying duplicate service %s", type_name)
-                local.destroy()
 
     def _try_claim(self, srvid: str, type_name: str):
         self._claiming.discard(srvid)
         if srvid in self.game.srvmap:
             return  # someone else won while we waited
-        eid = gen_id()
+        # if we already host a live instance (e.g. the registry was purged
+        # while our dispatcher link was down), re-register IT -- claiming a
+        # fresh id would duplicate the entity locally
+        existing = next(
+            (e for e in self.game.rt.entities.entities.values()
+             if e.type_name == type_name), None,
+        )
+        eid = existing.id if existing is not None else gen_id()
         self.game.declare_service(srvid, f"{self.game.id}/{eid}")
 
     def _instantiate(self, type_name: str, eid: str):

@@ -13,7 +13,7 @@ from __future__ import annotations
 import time
 from typing import Callable
 
-from ..utils import gwlog
+from ..utils import gwlog, opmon
 from ..utils.asyncjobs import JobError, OrderedWorker
 from .backends import EntityStorageBackend
 
@@ -60,7 +60,11 @@ class EntityStorageService:
         self._submit(lambda: self.backend.list_entity_ids(type_name), callback)
 
     def _submit(self, op, callback):
-        self._worker.submit(op, callback)
+        def monitored(op=op):
+            with opmon.Operation("storage.op"):
+                return op()
+
+        self._worker.submit(monitored, callback)
         depth = self._worker.pending()
         if depth > QUEUE_WARN_LEN:
             self.log.warning("storage queue depth %d", depth)

@@ -64,3 +64,40 @@ def dump() -> dict[str, dict]:
 def reset():
     with _lock:
         _stats.clear()
+
+
+_dump_thread: threading.Thread | None = None
+_dump_stop: threading.Event | None = None
+
+
+def start_periodic_dump(interval: float, logger) -> None:
+    """Log the op table every ``interval`` seconds (reference: opmon's
+    periodic dump, opmon.go:26-35,70-95).  Idempotent while a dumper is
+    running; each start gets its own stop event so stop-then-start cannot
+    leave a fresh thread observing a stale stop flag."""
+    global _dump_thread, _dump_stop
+    if (_dump_thread is not None and _dump_thread.is_alive()
+            and _dump_stop is not None and not _dump_stop.is_set()):
+        return
+    stop = threading.Event()
+    _dump_stop = stop
+
+    def run():
+        while not stop.wait(interval):
+            table = dump()
+            if not table:
+                continue
+            lines = [
+                f"  {name:32s} x{st['count']:<8d} avg {st['avg_ms']:8.2f} ms"
+                f"  max {st['max_ms']:8.2f} ms"
+                for name, st in sorted(table.items())
+            ]
+            logger.info("opmon:\n%s", "\n".join(lines))
+
+    _dump_thread = threading.Thread(target=run, daemon=True)
+    _dump_thread.start()
+
+
+def stop_periodic_dump() -> None:
+    if _dump_stop is not None:
+        _dump_stop.set()

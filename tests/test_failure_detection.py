@@ -175,3 +175,59 @@ def test_heartbeat_timeout_kicks_silent_client(tmp_path):
         for g in (g1, g2):
             g.stop()
         disp.stop()
+
+
+def test_provider_link_drop_no_split_brain(tmp_path):
+    """A service provider whose dispatcher link drops transiently must not
+    keep a stale singleton claim: the dispatcher purges its registration,
+    and on reconnect the snapshot prunes the provider's stale srvmap entry
+    so reconciliation converges to exactly one live instance."""
+    CounterService.created_on.clear()
+    disp, (g1, g2), gate = make_cluster(tmp_path)
+    try:
+        assert _wait(lambda: len(CounterService.created_on) == 1)
+        owner_gid = CounterService.created_on[0]
+        owner, survivor = (g1, g2) if owner_gid == 1 else (g2, g1)
+
+        # drop only the TCP link (process stays up; cluster auto-reconnects)
+        conn = owner.cluster.conns[0]
+        assert conn is not None
+        conn.close()
+
+        # dispatcher purges the registration; eventually the registry maps
+        # the service again (either side may win the re-claim)
+        assert _wait(
+            lambda: "service/CounterService" in disp.srvdis
+            and all("service/CounterService" in g.srvmap for g in (g1, g2)),
+            20,
+        ), "registry never reconverged after link drop"
+
+        def live_instances():
+            out = []
+            for g in (g1, g2):
+                for e in g.rt.entities.entities.values():
+                    if e.type_name == "CounterService":
+                        out.append((g.id, e.id))
+            return out
+
+        # converges to exactly one live instance, and every game's srvmap
+        # points at it
+        def consistent():
+            inst = live_instances()
+            if len(inst) != 1:
+                return False
+            gid, eid = inst[0]
+            want = f"{gid}/{eid}"
+            return all(
+                g.srvmap.get("service/CounterService") == want
+                for g in (g1, g2)
+            )
+        assert _wait(consistent, 20), (
+            f"split brain persists: instances={live_instances()}, "
+            f"maps={[g.srvmap.get('service/CounterService') for g in (g1, g2)]}"
+        )
+    finally:
+        gate.stop()
+        for g in (g1, g2):
+            g.stop()
+        disp.stop()
