@@ -100,17 +100,27 @@ class Stats:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--gate", default="127.0.0.1:17001")
+    ap.add_argument(
+        "--gate", default="127.0.0.1:17001",
+        help="gate address, or a comma-separated list -- bots spread over "
+             "them round-robin (reference: ClientBot picks any gate, "
+             "ClientBot.go:81-84)",
+    )
     ap.add_argument("-N", type=int, default=10)
     ap.add_argument("--duration", type=float, default=10.0)
     ap.add_argument("--strict", action="store_true")
     ap.add_argument("--transport", default="tcp", choices=["tcp", "ws", "kcp"])
     ap.add_argument("--tls", action="store_true")
     args = ap.parse_args()
-    host, port = args.gate.rsplit(":", 1)
-    addr = (host, int(port))
+    addrs = []
+    for part in args.gate.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, port = part.rsplit(":", 1)
+        addrs.append((host, int(port)))
     stats = Stats()
-    bots = [Bot(addr, i, args.duration, args.strict, stats,
+    bots = [Bot(addrs[i % len(addrs)], i, args.duration, args.strict, stats,
                 transport=args.transport, tls=args.tls) for i in range(args.N)]
     for b in bots:
         b.start()

@@ -122,6 +122,7 @@ class DispatcherService:
         self._stop.set()
         if self._listener:
             self._listener.close()
+        opmon.stop_periodic_dump()
 
     def _on_connection(self, sock, peer_addr):
         pc = PacketConnection(sock)

@@ -146,6 +146,7 @@ class GameService:
             terminate()
         if self.storage is not None:
             self.storage.wait_idle(5.0)
+        opmon.stop_periodic_dump()
         self.cluster.stop()
 
     def _register_to_dispatcher(self, conn: GWConnection):

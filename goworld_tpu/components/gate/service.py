@@ -139,6 +139,7 @@ class GateService:
 
     def stop(self):
         self._stop.set()
+        opmon.stop_periodic_dump()
         self.cluster.stop()
         if self._listener:
             self._listener.close()

@@ -89,6 +89,26 @@ class AOIEngine:
         self.default_backend = default_backend
         self.oracle_algorithm = oracle_algorithm
         self._buckets: dict[tuple[str, int], _Bucket] = {}
+        if default_backend == "tpu":
+            # fail FAST at process boot, not on the first space's first
+            # tick: a game configured for tpu whose jax backend is broken
+            # (e.g. an explicitly requested device plugin that cannot load)
+            # would otherwise come up "healthy" and swallow an error per
+            # tick forever.  A *silent* cpu fallback (plugin simply absent)
+            # passes this probe but runs the kernel interpreted -- warn
+            # loudly; that is right for hermetic tests and wrong for prod.
+            import jax
+            import jax.numpy as jnp
+
+            jnp.zeros(8).block_until_ready()
+            if jax.default_backend() == "cpu":
+                from ..utils import gwlog
+
+                gwlog.logger("gw.aoi").warning(
+                    "aoi_backend=tpu but jax default backend is CPU -- the "
+                    "kernel will run in interpret mode (fine for tests, "
+                    "orders of magnitude too slow for production)"
+                )
 
     def create_space(self, capacity: int, backend: str | None = None) -> SpaceAOIHandle:
         backend = backend or self.default_backend

@@ -1,0 +1,38 @@
+# -*- coding: utf-8 -*-
+"""goworld_tpu 中文文档门面 (reference role: cn/goworld_cn.go -- 同一 API,
+中文说明).
+
+本模块与 :mod:`goworld_tpu.goworld` 完全相同, 仅提供中文文档入口:
+
+* **进程模型**: 一个集群由 1+ 个 dispatcher(消息路由), 1+ 个 game(实体
+  逻辑), 1+ 个 gate(客户端接入)组成; game 和 gate 只连接 dispatcher,
+  互相之间没有直接连接。
+* **线程约定**: 每个 game 进程只有一个逻辑线程; 所有实体回调(RPC、定时器、
+  AOI 事件)都在该线程执行, **回调中禁止阻塞**。 其它线程(网络收包、
+  存储)只通过 post 队列把结果送回逻辑线程。
+* **Space 与 AOI**: Space 也是实体; ``enable_aoi(distance)`` 打开视野
+  管理。 视野事件(``on_enter_aoi`` / ``on_leave_aoi``)按 tick 批量计算 --
+  在 TPU 后端下, 同容量的所有 Space 由一个融合 Pallas 内核一次算完,
+  Space 分片到多芯片且无跨芯片集合通信。
+* **实体迁移**: ``enter_space(space_id, pos)`` 可跨 game 迁移实体,
+  迁移期间对该实体的调用由 dispatcher 排队, 不会丢失。
+* **持久化**: ``persistent = True`` 的实体按 ``save_interval_s`` 周期
+  保存; ``kvdb_get/kvdb_put`` 提供全局 KV 存储, 回调在逻辑线程执行。
+* **热更新**: ``cli reload`` 冻结所有实体状态到磁盘并用 ``-restore``
+  重启 game, 客户端连接保持不断。
+
+用法::
+
+    from goworld_tpu import goworld_cn as goworld
+
+    class Avatar(goworld.Entity):
+        use_aoi = True
+        aoi_distance = 100.0
+
+    def setup(game):
+        goworld.register_entity(Avatar)
+
+API 细节见 :mod:`goworld_tpu.goworld` 与 docs/migrating-from-goworld.md。
+"""
+
+from .goworld import *  # noqa: F401,F403

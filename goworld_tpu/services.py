@@ -34,6 +34,7 @@ class ServiceManager:
         self.log = gwlog.logger(f"service.game{game.id}")
         self.registered: dict[str, type] = {}  # service type name -> class
         self._claiming: set[str] = set()
+        self._last_swept: dict[str, str] = {}  # type -> info last stray-swept
         self._check_timer = None
         game.on_srvdis_update = self._on_srvdis_update
 
@@ -71,16 +72,20 @@ class ServiceManager:
             # every local instance of the type that is NOT the registered
             # one is a stray (e.g. a stale claim kept through a dispatcher
             # link drop) and must go -- matching only the registered eid
-            # would leave strays with other ids alive forever
-            strays = [
-                e for e in list(self.game.rt.entities.entities.values())
-                if e.type_name == type_name
-                and not (game_id == self.game.id and e.id == eid)
-            ]
-            for e in strays:
-                self.log.info("destroying duplicate service %s (%s)",
-                              type_name, e.id)
-                e.destroy()
+            # would leave strays with other ids alive forever.  The scan is
+            # O(entities), so only sweep when this type's registration
+            # actually changed, not on every 1 s reconcile tick.
+            if self._last_swept.get(type_name) != info:
+                self._last_swept[type_name] = info
+                strays = [
+                    e for e in list(self.game.rt.entities.entities.values())
+                    if e.type_name == type_name
+                    and not (game_id == self.game.id and e.id == eid)
+                ]
+                for e in strays:
+                    self.log.info("destroying duplicate service %s (%s)",
+                                  type_name, e.id)
+                    e.destroy()
             if game_id == self.game.id and self.game.rt.entities.get(eid) is None:
                 self._instantiate(type_name, eid)
 
