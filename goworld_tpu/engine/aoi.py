@@ -101,13 +101,16 @@ class AOIEngine:
             import jax.numpy as jnp
 
             jnp.zeros(8).block_until_ready()
-            if jax.default_backend() == "cpu":
+            if jax.default_backend() not in ("tpu", "axon"):
+                # mirrors the kernel's own interpret condition (platform
+                # != tpu -> interpret mode) so a cpu/gpu fallback is loud
                 from ..utils import gwlog
 
                 gwlog.logger("gw.aoi").warning(
-                    "aoi_backend=tpu but jax default backend is CPU -- the "
+                    "aoi_backend=tpu but jax default backend is %r -- the "
                     "kernel will run in interpret mode (fine for tests, "
-                    "orders of magnitude too slow for production)"
+                    "orders of magnitude too slow for production)",
+                    jax.default_backend(),
                 )
 
     def create_space(self, capacity: int, backend: str | None = None) -> SpaceAOIHandle:

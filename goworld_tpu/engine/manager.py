@@ -39,6 +39,10 @@ class EntityManager:
         self.registry: dict[str, EntityTypeDesc] = {}
         self.entities: dict[str, Entity] = {}
         self.spaces: dict[str, "Space"] = {}
+        # per-type live instances (reference: entity lists per type,
+        # entity_map.go); O(1) maintenance, used by services reconciliation
+        # and type-scoped queries
+        self.by_type: dict[str, set[str]] = {}
 
     # -- registration ------------------------------------------------------
     def register(self, cls: type, type_name: str | None = None) -> EntityTypeDesc:
@@ -87,6 +91,7 @@ class EntityManager:
             e.attrs.assign(attrs)
         e.on_init()
         self.entities[e.id] = e
+        self.by_type.setdefault(type_name, set()).add(e.id)
         if desc.is_space:
             self.spaces[e.id] = e  # type: ignore[assignment]
         cb = getattr(self.runtime, "on_entity_registered", None)
@@ -138,6 +143,9 @@ class EntityManager:
     def _on_entity_destroyed(self, e: Entity):
         self.entities.pop(e.id, None)
         self.spaces.pop(e.id, None)
+        ids = self.by_type.get(e.type_name)
+        if ids is not None:
+            ids.discard(e.id)
         cb = getattr(self.runtime, "on_entity_unregistered", None)
         if cb is not None:
             cb(e)

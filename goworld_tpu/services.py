@@ -34,7 +34,6 @@ class ServiceManager:
         self.log = gwlog.logger(f"service.game{game.id}")
         self.registered: dict[str, type] = {}  # service type name -> class
         self._claiming: set[str] = set()
-        self._last_swept: dict[str, str] = {}  # type -> info last stray-swept
         self._check_timer = None
         game.on_srvdis_update = self._on_srvdis_update
 
@@ -72,21 +71,19 @@ class ServiceManager:
             # every local instance of the type that is NOT the registered
             # one is a stray (e.g. a stale claim kept through a dispatcher
             # link drop) and must go -- matching only the registered eid
-            # would leave strays with other ids alive forever.  The scan is
-            # O(entities), so only sweep when this type's registration
-            # actually changed, not on every 1 s reconcile tick.
-            if self._last_swept.get(type_name) != info:
-                self._last_swept[type_name] = info
-                strays = [
-                    e for e in list(self.game.rt.entities.entities.values())
-                    if e.type_name == type_name
-                    and not (game_id == self.game.id and e.id == eid)
-                ]
-                for e in strays:
+            # would leave strays with other ids alive forever.  The
+            # per-type index makes this O(live instances), so it runs on
+            # every reconcile tick.
+            em = self.game.rt.entities
+            for stray_id in list(em.by_type.get(type_name, ())):
+                if game_id == self.game.id and stray_id == eid:
+                    continue
+                stray = em.get(stray_id)
+                if stray is not None:
                     self.log.info("destroying duplicate service %s (%s)",
-                                  type_name, e.id)
-                    e.destroy()
-            if game_id == self.game.id and self.game.rt.entities.get(eid) is None:
+                                  type_name, stray_id)
+                    stray.destroy()
+            if game_id == self.game.id and em.get(eid) is None:
                 self._instantiate(type_name, eid)
 
     def _try_claim(self, srvid: str, type_name: str):
@@ -96,11 +93,8 @@ class ServiceManager:
         # if we already host a live instance (e.g. the registry was purged
         # while our dispatcher link was down), re-register IT -- claiming a
         # fresh id would duplicate the entity locally
-        existing = next(
-            (e for e in self.game.rt.entities.entities.values()
-             if e.type_name == type_name), None,
-        )
-        eid = existing.id if existing is not None else gen_id()
+        ids = self.game.rt.entities.by_type.get(type_name)
+        eid = next(iter(ids)) if ids else gen_id()
         self.game.declare_service(srvid, f"{self.game.id}/{eid}")
 
     def _instantiate(self, type_name: str, eid: str):

@@ -68,19 +68,23 @@ def reset():
 
 _dump_thread: threading.Thread | None = None
 _dump_stop: threading.Event | None = None
+_dump_refs = 0
 
 
 def start_periodic_dump(interval: float, logger) -> None:
     """Log the op table every ``interval`` seconds (reference: opmon's
-    periodic dump, opmon.go:26-35,70-95).  Idempotent while a dumper is
-    running; each start gets its own stop event so stop-then-start cannot
-    leave a fresh thread observing a stale stop flag."""
-    global _dump_thread, _dump_stop
-    if (_dump_thread is not None and _dump_thread.is_alive()
-            and _dump_stop is not None and not _dump_stop.is_set()):
-        return
-    stop = threading.Event()
-    _dump_stop = stop
+    periodic dump, opmon.go:26-35,70-95).  Refcounted: components co-hosted
+    in one process each start/stop it; the dumper thread runs while at
+    least one is alive.  Each start gets its own stop event so
+    stop-then-start cannot leave a fresh thread observing a stale flag."""
+    global _dump_thread, _dump_stop, _dump_refs
+    with _lock:
+        _dump_refs += 1
+        if (_dump_thread is not None and _dump_thread.is_alive()
+                and _dump_stop is not None and not _dump_stop.is_set()):
+            return
+        stop = threading.Event()
+        _dump_stop = stop
 
     def run():
         while not stop.wait(interval):
@@ -99,5 +103,8 @@ def start_periodic_dump(interval: float, logger) -> None:
 
 
 def stop_periodic_dump() -> None:
-    if _dump_stop is not None:
-        _dump_stop.set()
+    global _dump_refs
+    with _lock:
+        _dump_refs = max(0, _dump_refs - 1)
+        if _dump_refs == 0 and _dump_stop is not None:
+            _dump_stop.set()

@@ -63,7 +63,7 @@ def two_games():
     disp.stop()
 
 
-def wait_for(pred, timeout=10.0):
+def wait_for(pred, timeout=25.0):  # generous: full-suite runs are noisy
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if pred():
