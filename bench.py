@@ -41,6 +41,7 @@ STEP = 5.0
 TPU_TICKS = int(os.environ.get("BENCH_TICKS", 30))
 CHUNK = int(os.environ.get("BENCH_CHUNK", 5))
 CPU_TICKS = int(os.environ.get("BENCH_CPU_TICKS", 3))
+REPS = int(os.environ.get("BENCH_REPS", 3))
 MAX_WORDS = int(os.environ.get("BENCH_MAX_WORDS", 1 << 17))
 ZIPF = os.environ.get("BENCH_ZIPF", "") == "1"  # hotspot density config
 VAR_RADIUS = os.environ.get("BENCH_VAR_RADIUS", "") == "1"  # per-entity radius
@@ -133,9 +134,15 @@ def bench_tpu(xs, zs):
     wz = jnp.asarray(zs[1:1 + chunk])
     _wfinal, ((_, _, wne), (_, _, wnl)) = run(wx, wz, prev1)
     peak = int(max(np.asarray(wne).max(), np.asarray(wnl).max()))
+    # re-fit the device-side word cap to the observed density (x2 headroom,
+    # 64k-aligned): growing avoids overflowing every tick on dense configs
+    # (Zipfian); shrinking halves the top_k sizes on sparse ones, but never
+    # overrides an explicitly set BENCH_MAX_WORDS
+    fitted = max(65536, -(-int(peak * 2) // 65536) * 65536)
+    env_cap = "BENCH_MAX_WORDS" in os.environ
     max_words = MAX_WORDS
-    if peak * 1.2 > max_words:
-        max_words = -(-int(peak * 2) // 65536) * 65536
+    if peak * 1.2 > max_words or (fitted < max_words and not env_cap):
+        max_words = fitted
         run = make_run(max_words)
         _wfinal, ((_, _, wne), (_, _, wnl)) = run(wx, wz, prev1)
         peak = int(max(np.asarray(wne).max(), np.asarray(wnl).max()))
@@ -155,9 +162,7 @@ def bench_tpu(xs, zs):
         nl.copy_to_host_async()
         return arrs, ne, nl, ev
 
-    stats = {"events": 0, "overflow": 0, "slow_path": 0}
-
-    def finish(harvested):
+    def finish(harvested, stats):
         (vals_e, idx_e, vals_l, idx_l), ne, nl, ev = harvested
         ne_h, nl_h = np.asarray(ne), np.asarray(nl)
         stats["overflow"] += int((ne_h > max_words).sum()
@@ -181,22 +186,33 @@ def bench_tpu(xs, zs):
             plv = expand_words_host(vl, il, CAP, S)
             stats["events"] += len(pe) + len(plv)
 
-    t0 = time.perf_counter()
-    prev = prev1
-    pending = None
-    t_device = 0.0
-    for ci in range(n_chunks):
-        lo = 1 + ci * chunk
-        cx = jax.device_put(xs[lo:lo + chunk])
-        cz = jax.device_put(zs[lo:lo + chunk])
-        prev, ev = run(cx, cz, prev)  # async dispatch
-        if pending is not None:
-            finish(pending)  # expands chunk ci-1 while ci computes
-        pending = harvest(ev)
-    jax.block_until_ready(prev)
-    t_device = time.perf_counter() - t0  # all compute drained
-    finish(pending)
-    dt = time.perf_counter() - t0
+    def one_rep():
+        rep_stats = {"events": 0, "overflow": 0, "slow_path": 0}
+        t0 = time.perf_counter()
+        prev = prev1
+        pending = None
+        for ci in range(n_chunks):
+            lo = 1 + ci * chunk
+            cx = jax.device_put(xs[lo:lo + chunk])
+            cz = jax.device_put(zs[lo:lo + chunk])
+            prev, ev = run(cx, cz, prev)  # async dispatch
+            if pending is not None:
+                finish(pending, rep_stats)  # expands ci-1 while ci computes
+            pending = harvest(ev)
+        jax.block_until_ready(prev)
+        t_device = time.perf_counter() - t0  # all compute drained
+        finish(pending, rep_stats)
+        return time.perf_counter() - t0, t_device, rep_stats
+
+    # the dev harness reaches the chip over a shared network tunnel whose
+    # load varies run to run by up to ~4x; best-of-REPS measures the
+    # pipeline, not the tunnel's weather
+    best = None
+    for _ in range(REPS):
+        dt, t_device, rep_stats = one_rep()
+        if best is None or dt < best[0]:
+            best = (dt, t_device, rep_stats)
+    dt, t_device, stats = best
     return {
         "moves_per_sec": S * CAP * ticks / dt,
         "events_per_tick": stats["events"] / ticks,

@@ -101,9 +101,10 @@ class AOIEngine:
             import jax.numpy as jnp
 
             jnp.zeros(8).block_until_ready()
-            if jax.default_backend() not in ("tpu", "axon"):
-                # mirrors the kernel's own interpret condition (platform
-                # != tpu -> interpret mode) so a cpu/gpu fallback is loud
+            if jax.default_backend() != "tpu":
+                # EXACTLY the kernel's interpret condition
+                # (aoi_pallas: backend != "tpu" -> interpret mode), so any
+                # interpreted fallback is loud
                 from ..utils import gwlog
 
                 gwlog.logger("gw.aoi").warning(

@@ -86,20 +86,22 @@ def start_periodic_dump(interval: float, logger) -> None:
         stop = threading.Event()
         _dump_stop = stop
 
-    def run():
-        while not stop.wait(interval):
-            table = dump()
-            if not table:
-                continue
-            lines = [
-                f"  {name:32s} x{st['count']:<8d} avg {st['avg_ms']:8.2f} ms"
-                f"  max {st['max_ms']:8.2f} ms"
-                for name, st in sorted(table.items())
-            ]
-            logger.info("opmon:\n%s", "\n".join(lines))
+        def run():
+            while not stop.wait(interval):
+                table = dump()
+                if not table:
+                    continue
+                lines = [
+                    f"  {name:32s} x{st['count']:<8d} avg {st['avg_ms']:8.2f} ms"
+                    f"  max {st['max_ms']:8.2f} ms"
+                    for name, st in sorted(table.items())
+                ]
+                logger.info("opmon:\n%s", "\n".join(lines))
 
-    _dump_thread = threading.Thread(target=run, daemon=True)
-    _dump_thread.start()
+        # still inside _lock: a concurrent start must not spawn a second
+        # dumper whose stop event was just orphaned
+        _dump_thread = threading.Thread(target=run, daemon=True)
+        _dump_thread.start()
 
 
 def stop_periodic_dump() -> None:
