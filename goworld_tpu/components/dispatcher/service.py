@@ -196,11 +196,27 @@ class DispatcherService:
         peer.kind, peer.id = "game", gid
         gi = self.games.setdefault(gid, _GameInfo())
         gi.conn = peer
-        # reconcile directory: entities the game claims that now map elsewhere
-        # are rejected back (reference: DispatcherService.go:376-398)
+        # reconcile directory: entities the game claims that now map to a
+        # DIFFERENT live game are rejected back so the claimer destroys its
+        # duplicate (reference: DispatcherService.go:376-398); dead or
+        # unmapped entries are simply (re)claimed
+        rejected = 0
         for eid in eids:
             ei = self.entities.setdefault(eid, _EntityInfo())
+            cur = self.games.get(ei.game_id)
+            cur_live = cur is not None and (
+                cur.frozen or (cur.conn is not None and cur.conn.alive)
+            )
+            if ei.game_id not in (0, gid) and cur_live:
+                out = Packet.for_msgtype(MT.MT_REJECT_DUPLICATE_ENTITY)
+                out.append_entity_id(eid)
+                peer.send(out)
+                rejected += 1
+                continue
             ei.game_id = gid
+        if rejected:
+            self.log.warning("game%d: rejected %d duplicate entities",
+                             gid, rejected)
         if is_restore and gi.frozen:
             gi.frozen = False
             self._unblock_game(gi)

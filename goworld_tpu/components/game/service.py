@@ -72,6 +72,7 @@ class GameService:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._registering_suppressed = False
+        self._suppress_notify_eids: set[str] = set()
         self._lbc = LoadReporter()
         self.storage = None  # EntityStorageService, via attach_storage
         self.kvdb = None  # KVDBService, via attach_kvdb
@@ -150,7 +151,16 @@ class GameService:
         self.cluster.stop()
 
     def _register_to_dispatcher(self, conn: GWConnection):
-        eids = list(self.rt.entities.entities.keys())
+        # register only the eids of THIS dispatcher's shard: create/destroy
+        # notifications are shard-routed, so handing every dispatcher the
+        # full list would leave non-shard directories with entries that rot
+        # (and then mis-fire duplicate rejection)
+        from ...dispatchercluster import entity_shard
+
+        n = len(self.cluster.addrs)
+        idx = getattr(conn, "index", 0)
+        eids = [eid for eid in self.rt.entities.entities
+                if entity_shard(eid, n) == idx]
         # is_restore unblocks the dispatcher's frozen-game queue after a
         # hot reload (reference: reconnect-with-restore, GameService freeze)
         conn.send_set_game_id(self.id, getattr(self, "_is_restore", False), eids)
@@ -413,6 +423,28 @@ class GameService:
             x, y, z = data["pos"]
             sp.enter_entity(e, Vector3(x, y, z))
 
+    def _h_reject_duplicate_entity(self, pkt):
+        """The dispatcher says our claimed entity lives on another game
+        (e.g. a stale copy kept through a failed migration + reconnect):
+        tear the local duplicate down QUIETLY -- migrate-style (no save: a
+        stale copy must not clobber the legitimate owner's persisted state;
+        no on_destroy side effects; no client destroy packet) and without a
+        directory notify for this eid, which would wrongly evict the
+        legitimate owner's mapping."""
+        eid = pkt.read_entity_id()
+        e = self.rt.entities.get(eid)
+        if e is None:
+            return
+        self.log.warning("destroying duplicate entity %s (lives elsewhere)", eid)
+        e.client = None  # the real entity owns the client
+        self._suppress_notify_eids.add(eid)
+        try:
+            gwutils.run_panicless(
+                lambda: e._destroy_impl(is_migrate=True), logger=self.log
+            )
+        finally:
+            self._suppress_notify_eids.discard(eid)
+
     def _h_game_disconnected(self, pkt):
         gid = pkt.read_u16()
         self.log.info("peer game%d disconnected", gid)
@@ -444,6 +476,7 @@ class GameService:
         MT.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE: _h_query_space_gameid_ack,
         MT.MT_MIGRATE_REQUEST: _h_migrate_request_ack,
         MT.MT_REAL_MIGRATE: _h_real_migrate,
+        MT.MT_REJECT_DUPLICATE_ENTITY: _h_reject_duplicate_entity,
         MT.MT_NOTIFY_GAME_DISCONNECTED: _h_game_disconnected,
         MT.MT_NOTIFY_GATE_DISCONNECTED: _h_gate_disconnected,
         MT.MT_START_FREEZE_GAME_ACK: _h_freeze_ack,
@@ -453,14 +486,14 @@ class GameService:
     def _on_entity_registered(self, e: Entity):
         if e.persistent and self.gcfg.save_interval_s > 0:
             e.add_timer(float(self.gcfg.save_interval_s), "save")
-        if self._registering_suppressed:
+        if self._registering_suppressed or e.id in self._suppress_notify_eids:
             return
         conn = self.cluster.by_entity(e.id)
         if conn:
             conn.send_notify_create_entity(e.id)
 
     def _on_entity_unregistered(self, e: Entity):
-        if self._registering_suppressed:
+        if self._registering_suppressed or e.id in self._suppress_notify_eids:
             return
         conn = self.cluster.by_entity(e.id)
         if conn:

@@ -87,6 +87,7 @@ class DispatcherCluster:
                 time.sleep(1.0)
                 continue
             conn = GWConnection(PacketConnection(sock))
+            conn.index = i  # which dispatcher shard this link serves
             self.register(conn)
             conn.flush()
             self.conns[i] = conn

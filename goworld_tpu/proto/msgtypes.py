@@ -21,6 +21,7 @@ MT_NOTIFY_DEPLOYMENT_READY = 7     # disp -> all: barrier passed
 MT_NOTIFY_GAME_CONNECTED = 8
 MT_NOTIFY_GAME_DISCONNECTED = 9
 MT_NOTIFY_GATE_DISCONNECTED = 10
+MT_REJECT_DUPLICATE_ENTITY = 11  # disp -> game: your claimed eid lives elsewhere
 
 # -- entity creation / RPC routing ----------------------------------------
 MT_CREATE_ENTITY_ANYWHERE = 20  # game -> disp: type, attrs (LBC placement)

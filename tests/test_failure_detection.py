@@ -231,3 +231,54 @@ def test_provider_link_drop_no_split_brain(tmp_path):
         for g in (g1, g2):
             g.stop()
         disp.stop()
+
+
+def test_reconnect_duplicate_entities_rejected(tmp_path):
+    """Reconnect reconciliation (reference: DispatcherService.go:376-398):
+    a game re-registering an entity id that the directory maps to another
+    LIVE game gets it rejected and destroys its local duplicate; the
+    legitimate owner keeps the id and its directory mapping."""
+    disp, (g1, g2), gate = make_cluster(tmp_path)
+    try:
+        # legit entity on g1
+        box = []
+        g1.rt.post.post(
+            lambda: box.append(g1.rt.entities.create("FDAvatar").id)
+        )
+        assert _wait(lambda: bool(box))
+        eid = box[0]
+        assert _wait(
+            lambda: disp.entities.get(eid) is not None
+            and disp.entities[eid].game_id == 1
+        )
+
+        # simulate a stale copy on g2 (e.g. left by a failed migration):
+        # create it with directory notifications suppressed
+        def stale():
+            g2._registering_suppressed = True
+            try:
+                g2.rt.entities.create("FDAvatar", eid=eid)
+            finally:
+                g2._registering_suppressed = False
+        g2.rt.post.post(stale)
+        assert _wait(lambda: g2.rt.entities.get(eid) is not None)
+
+        # force g2 to reconnect -> it re-registers its full entity list
+        conn = g2.cluster.conns[0]
+        assert conn is not None
+        conn.close()
+
+        # the duplicate is rejected and destroyed; g1 keeps the entity and
+        # the directory still maps it to g1
+        assert _wait(lambda: g2.rt.entities.get(eid) is None, 15), \
+            "duplicate on game2 never destroyed"
+        assert g1.rt.entities.get(eid) is not None
+        assert _wait(
+            lambda: disp.entities.get(eid) is not None
+            and disp.entities[eid].game_id == 1
+        )
+    finally:
+        gate.stop()
+        for g in (g1, g2):
+            g.stop()
+        disp.stop()
