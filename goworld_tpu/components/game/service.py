@@ -158,8 +158,10 @@ class GameService:
         from ...dispatchercluster import entity_shard
 
         n = len(self.cluster.addrs)
-        idx = getattr(conn, "index", 0)
-        eids = [eid for eid in self.rt.entities.entities
+        idx = conn.index  # set by DispatcherCluster before register()
+        # snapshot first: this runs on the cluster connect thread while the
+        # logic thread mutates the entities dict
+        eids = [eid for eid in list(self.rt.entities.entities)
                 if entity_shard(eid, n) == idx]
         # is_restore unblocks the dispatcher's frozen-game queue after a
         # hot reload (reference: reconnect-with-restore, GameService freeze)
