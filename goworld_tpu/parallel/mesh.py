@@ -30,15 +30,30 @@ def multichip_devices(n: int | None = None):
     else the host-CPU backend (8 virtual devices under
     ``--xla_force_host_platform_device_count=8`` -- the single-real-chip dev
     setup).  ``n=None`` means "as many as the default backend offers"."""
-    devs = jax.devices()
+    def _cpu_devices():
+        try:
+            return jax.devices("cpu")
+        except Exception:
+            # A JAX_PLATFORMS entry whose plugin failed to load poisons every
+            # backend query; dropping to the host platform alone recovers the
+            # virtual-device dryrun path.
+            try:
+                jax.config.update("jax_platforms", "cpu")
+                return jax.devices("cpu")
+            except Exception:
+                return []
+
+    try:
+        devs = jax.devices()
+    except Exception:
+        # Default backend failed to initialize (e.g. a libtpu/plugin mismatch
+        # in a CPU-only dryrun container) -- fall through to the CPU backend.
+        devs = []
     if n is None:
-        return devs
+        return devs if devs else _cpu_devices()
     if len(devs) >= n:
         return devs[:n]
-    try:
-        cpu = jax.devices("cpu")
-    except RuntimeError:
-        cpu = []
+    cpu = _cpu_devices()
     if len(cpu) >= n:
         return cpu[:n]
     raise RuntimeError(
