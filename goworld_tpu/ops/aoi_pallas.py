@@ -51,42 +51,104 @@ from .aoi_predicate import WORD_BITS, words_per_row
 _INF = float("inf")
 
 
-def _mask_block(x_row, z_row, r_row, x_col, z_col, *, ti, w):
+def _mask_block(x_row, z_row, r_row, x_col, z_col, *, ti, col_off=0):
     bi = pl.program_id(1)
-    c = WORD_BITS * w
+    cb = x_col.shape[-1]
     xr = x_row[0, 0].reshape(ti, 1)
     zr = z_row[0, 0].reshape(ti, 1)
     rr = r_row[0, 0].reshape(ti, 1)
-    xc = x_col[0, 0].reshape(1, c)
-    zc = z_col[0, 0].reshape(1, c)
+    xc = x_col[0, 0].reshape(1, cb)
+    zc = z_col[0, 0].reshape(1, cb)
     row_ids = bi * ti + jax.lax.broadcasted_iota(jnp.int32, (ti, 1), 0)
-    col_ids = jax.lax.broadcasted_iota(jnp.int32, (ti, c), 1)
+    col_ids = col_off + jax.lax.broadcasted_iota(jnp.int32, (ti, cb), 1)
     m = (jnp.abs(xc - xr) <= rr) & (jnp.abs(zc - zr) <= rr)
     return m & (row_ids != col_ids)
 
 
-def _write_diff(acc, prev, new_out, ent_out, lv_out):
+def _write_diff(acc, prev, *outs):
     accu = jax.lax.bitcast_convert_type(acc, jnp.uint32)
     pw = prev[0]
-    new_out[0] = accu
-    ent_out[0] = accu & ~pw
-    lv_out[0] = pw & ~accu
+    if len(outs) == 3:  # (new, enter, leave)
+        new_out, ent_out, lv_out = outs
+        new_out[0] = accu
+        ent_out[0] = accu & ~pw
+        lv_out[0] = pw & ~accu
+    else:  # (new, changed): changed = xor; enter = chg & new, leave = chg & ~new
+        new_out, chg_out = outs
+        new_out[0] = accu
+        chg_out[0] = accu ^ pw
 
 
-def _aoi_kernel_slicepack(x_row, z_row, r_row, x_col, z_col, prev, new_out,
-                          ent_out, lv_out, *, ti, w):
+def _aoi_kernel_slicepack(x_row, z_row, r_row, x_col, z_col, prev, *outs,
+                          ti, w, planes):
+    """Pure-VPU pack with column blocking.
+
+    Grid (S, C//ti, n_cb): this step sees the column slice
+    ``[ci*planes*w, (ci+1)*planes*w)``, which in the planar packed layout is
+    exactly bit planes ``[ci*planes, (ci+1)*planes)`` of every word -- so a
+    column block contributes whole bit planes and the ``new`` output block
+    (revisited across the innermost grid dim, Pallas keeps it resident in
+    VMEM) doubles as the cross-block accumulator.  Diff outputs are written
+    from the running accumulator; the last ci step's values are what lands
+    in HBM.  With n_cb == 1 this degenerates to the original single-pass
+    slice-pack (planes == 32).
+    """
+    ci = pl.program_id(2)
     m32 = _mask_block(
-        x_row, z_row, r_row, x_col, z_col, ti=ti, w=w
+        x_row, z_row, r_row, x_col, z_col, ti=ti, col_off=ci * planes * w
     ).astype(jnp.int32)
-    acc = jnp.zeros((ti, w), jnp.int32)
-    for k in range(WORD_BITS):
-        acc = acc | (m32[:, k * w:(k + 1) * w] << k)
-    _write_diff(acc, prev, new_out, ent_out, lv_out)
+    part = jnp.zeros((ti, w), jnp.int32)
+    for kk in range(planes):
+        # dynamic bit plane ci*planes + kk: shift via scalar multiply
+        kbit = jax.lax.shift_left(jnp.int32(1), ci * planes + kk)
+        part = part | (m32[:, kk * w:(kk + 1) * w] * kbit)
+    partu = jax.lax.bitcast_convert_type(part, jnp.uint32)
+    new_out = outs[0]
+    if planes == WORD_BITS:  # single column pass: no revisit read needed
+        acc = partu
+    else:
+        acc = jnp.where(ci == 0, partu, new_out[0] | partu)
+    pw = prev[0]
+    new_out[0] = acc
+    if len(outs) == 3:
+        outs[1][0] = acc & ~pw
+        outs[2][0] = pw & ~acc
+    else:
+        outs[1][0] = acc ^ pw
 
 
-def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_out, *, ti, w):
+def _aoi_kernel_planewise(x_row, z_row, r_row, x_col, z_col, prev, *outs,
+                          ti, w, wb):
+    """Slice-pack for very wide rows (w >= 2048, C >= 64k).
+
+    Grid (S, C//ti, w//wb, 32): one step computes ONE bit plane k over the
+    word range [wo*wb, (wo+1)*wb) -- its column slice is the contiguous
+    [k*w + wo*wb, k*w + (wo+1)*wb).  Keeping every block [ti, wb] bounds
+    VMEM at large C where the 3-dim scheme's [ti, w] blocks blow the scoped
+    limit (measured: 20.2 MB > 16 MB at C=131072).  The ``new`` output block
+    is revisited across the innermost (plane) dim and accumulates.
+    """
+    wo = pl.program_id(2)
+    k = pl.program_id(3)
+    m32 = _mask_block(
+        x_row, z_row, r_row, x_col, z_col, ti=ti, col_off=k * w + wo * wb
+    ).astype(jnp.int32)
+    kbit = jax.lax.shift_left(jnp.int32(1), k)
+    partu = jax.lax.bitcast_convert_type(m32 * kbit, jnp.uint32)
+    new_out = outs[0]
+    acc = jnp.where(k == 0, partu, new_out[0] | partu)
+    pw = prev[0]
+    new_out[0] = acc
+    if len(outs) == 3:
+        outs[1][0] = acc & ~pw
+        outs[2][0] = pw & ~acc
+    else:
+        outs[1][0] = acc ^ pw
+
+
+def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, *outs, ti, w):
     c = WORD_BITS * w
-    m = _mask_block(x_row, z_row, r_row, x_col, z_col, ti=ti, w=w)
+    m = _mask_block(x_row, z_row, r_row, x_col, z_col, ti=ti)
     mf = m.astype(jnp.float32)
 
     # Pack on the MXU, one byte plane per matmul (see module docstring).
@@ -100,15 +162,19 @@ def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, new_out, ent_out, lv_ou
         pb = jnp.where(band, jnp.exp2((k_ids - 8 * b).astype(jnp.float32)), 0.0)
         byte = jax.lax.dot(mf, pb, preferred_element_type=jnp.float32)
         acc = acc | (byte.astype(jnp.int32) << (8 * b))
-    _write_diff(acc, prev, new_out, ent_out, lv_out)
+    _write_diff(acc, prev, *outs)
 
 
-@functools.partial(jax.jit, static_argnames=("block_rows", "interpret"))
-def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128, interpret=None):
+@functools.partial(jax.jit, static_argnames=("block_rows", "interpret", "emit"))
+def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128,
+                    interpret=None, emit="entlv"):
     """Batched AOI tick on TPU.
 
     Args: x, z, radius [S, C] f32; active [S, C] bool; prev_words [S, C, W]
-    uint32.  Returns (new_words, enter_words, leave_words), each [S, C, W].
+    uint32.  With ``emit="entlv"`` (default) returns (new_words, enter_words,
+    leave_words); with ``emit="chg"`` returns (new_words, changed_words) where
+    ``changed = new ^ prev`` -- one fewer [S, C, W] HBM write per tick, and
+    enter/leave recover exactly as ``chg & new`` / ``chg & ~new``.
     Bit-exact with :func:`aoi_dense.aoi_step_dense` and the CPU oracle.
     """
     s, c = x.shape
@@ -131,20 +197,48 @@ def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128, interpr
     z_eff = jnp.where(active, z, jnp.float32(_INF)).reshape(s, 1, c)
     r_eff = jnp.where(active, radius, jnp.float32(-1.0)).reshape(s, 1, c)
 
-    row_spec = pl.BlockSpec((1, 1, ti), lambda si, bi: (si, 0, bi))
-    col_spec = pl.BlockSpec((1, 1, c), lambda si, bi: (si, 0, 0))
-    words_spec = pl.BlockSpec((1, ti, w), lambda si, bi: (si, bi, 0))
     out_shape = jax.ShapeDtypeStruct((s, c, w), jnp.uint32)
+    n_out = 3 if emit == "entlv" else 2
 
-    if w % 128 == 0:
-        kernel = functools.partial(_aoi_kernel_slicepack, ti=ti, w=w)
+    if w % 2048 == 0:
+        # Very wide rows: plane-wise 4-dim grid keeps blocks [ti, wb].
+        # (wb must divide w or the column BlockSpec and col_off disagree.)
+        wb = 2048
+        row_spec = pl.BlockSpec((1, 1, ti), lambda si, bi, wo, k: (si, 0, bi))
+        col_spec = pl.BlockSpec(
+            (1, 1, wb), lambda si, bi, wo, k: (si, 0, k * (w // wb) + wo))
+        words_spec = pl.BlockSpec(
+            (1, ti, wb), lambda si, bi, wo, k: (si, bi, wo))
+        kernel = functools.partial(_aoi_kernel_planewise, ti=ti, w=w, wb=wb)
+        grid = (s, c // ti, w // wb, WORD_BITS)
+    elif w % 128 == 0:
+        # Column-blocked slice-pack: cap the mask block at [ti, 8192] so VMEM
+        # stays bounded as C grows (a [128, C] mask is 64 MB at C=131072).
+        # A column block covers whole bit planes (cb = planes * w), and
+        # planes must divide WORD_BITS or the grid would drop the tail
+        # planes -- so planes is the largest power of two <= min(32, 8192/w).
+        planes = 1
+        while planes < WORD_BITS and planes * 2 * w <= 8192:
+            planes *= 2
+        cb = planes * w
+        n_cb = WORD_BITS // planes
+        row_spec = pl.BlockSpec((1, 1, ti), lambda si, bi, ci: (si, 0, bi))
+        col_spec = pl.BlockSpec((1, 1, cb), lambda si, bi, ci: (si, 0, ci))
+        words_spec = pl.BlockSpec((1, ti, w), lambda si, bi, ci: (si, bi, 0))
+        kernel = functools.partial(_aoi_kernel_slicepack, ti=ti, w=w,
+                                   planes=planes)
+        grid = (s, c // ti, n_cb)
     else:
+        row_spec = pl.BlockSpec((1, 1, ti), lambda si, bi: (si, 0, bi))
+        col_spec = pl.BlockSpec((1, 1, c), lambda si, bi: (si, 0, 0))
+        words_spec = pl.BlockSpec((1, ti, w), lambda si, bi: (si, bi, 0))
         kernel = functools.partial(_aoi_kernel, ti=ti, w=w)
+        grid = (s, c // ti)
     return pl.pallas_call(
         kernel,
-        grid=(s, c // ti),
+        grid=grid,
         in_specs=[row_spec, row_spec, row_spec, col_spec, col_spec, words_spec],
-        out_specs=(words_spec, words_spec, words_spec),
-        out_shape=(out_shape, out_shape, out_shape),
+        out_specs=(words_spec,) * n_out,
+        out_shape=(out_shape,) * n_out,
         interpret=interpret,
     )(x_eff, z_eff, r_eff, x_eff, z_eff, prev_words)

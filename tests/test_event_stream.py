@@ -1,0 +1,158 @@
+"""Segmented extraction + compressed word-stream encode/decode round-trip.
+
+The D2H event path (bench.py and the engine's device extraction) compacts
+changed interest words on device and ships ~3 bytes per word: single-bit
+words as a u8 bit position + u16 index delta, multi-bit words through a
+small exception stream (reference event semantics:
+/root/reference/engine/entity/Entity.go:227-233 -- the decoded stream
+replays the same onEnterAOI/onLeaveAOI pairs).
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.ops import words_per_row
+from goworld_tpu.ops.events import (
+    decode_word_stream,
+    encode_word_stream,
+    extract_nonzero_words,
+    extract_nonzero_words_segmented,
+)
+
+
+def _sparse_words(rng, s, c, density=0.002, multi_frac=0.05):
+    w = words_per_row(c)
+    arr = np.zeros((s, c, w), np.uint32)
+    n = int(s * c * w * density)
+    flat = rng.choice(s * c * w, size=n, replace=False)
+    bits = rng.integers(0, 32, size=n)
+    vals = (np.uint32(1) << bits.astype(np.uint32)).astype(np.uint32)
+    multi = rng.random(n) < multi_frac
+    extra = (np.uint32(1) << rng.integers(0, 32, size=n).astype(np.uint32))
+    vals = np.where(multi, vals | extra, vals).astype(np.uint32)
+    arr.reshape(-1)[flat] = vals
+    return arr
+
+
+@pytest.mark.parametrize("n_seg", [1, 4])
+def test_segmented_extraction_matches_flat(n_seg):
+    rng = np.random.default_rng(3)
+    words = _sparse_words(rng, 2, 512)
+    import jax.numpy as jnp
+
+    jw = jnp.asarray(words)
+    ref_nz = np.nonzero(words.reshape(-1))[0]
+    vals, gidx, cnt = extract_nonzero_words_segmented(jw, 1024, n_seg)
+    vals, gidx, cnt = map(np.asarray, (vals, gidx, cnt))
+    assert cnt.sum() == len(ref_nz)
+    got = np.sort(gidx[gidx >= 0])
+    assert (got == ref_nz).all()
+    for s in range(n_seg):
+        k = cnt[s]
+        row = gidx[s]
+        assert (row[:k] >= 0).all() and (np.diff(row[:k]) > 0).all()
+        assert (row[k:] == -1).all()
+        flat_vals = words.reshape(-1)
+        assert (vals[s, :k] == flat_vals[row[:k]]).all()
+
+
+@pytest.mark.parametrize("n_seg", [1, 4])
+@pytest.mark.parametrize("multi_frac", [0.0, 0.08])
+def test_stream_roundtrip(n_seg, multi_frac):
+    rng = np.random.default_rng(5)
+    words = _sparse_words(rng, 2, 1024, density=0.004, multi_frac=multi_frac)
+    import jax.numpy as jnp
+
+    jw = jnp.asarray(words)
+    vals, gidx, cnt = extract_nonzero_words_segmented(jw, 2048, n_seg)
+    bitpos, delta, base, gap_over, exc_vals, exc_new, exc_pos, exc_n = (
+        encode_word_stream(vals, gidx, cnt))
+    assert not np.asarray(gap_over).any()
+    dec_vals, dec_idx = decode_word_stream(
+        bitpos, delta, base, cnt, exc_vals, exc_pos)
+    flat = words.reshape(-1)
+    ref_idx = np.nonzero(flat)[0]
+    order = np.argsort(dec_idx)
+    assert (dec_idx[order] == ref_idx).all()
+    assert (dec_vals[order] == flat[ref_idx]).all()
+    nmulti = int((np.bitwise_count(flat) > 1).sum())
+    assert int(exc_n) == nmulti
+
+
+@pytest.mark.parametrize("n_seg", [1, 4])
+def test_stream_roundtrip_with_enter_bits(n_seg):
+    rng = np.random.default_rng(6)
+    chg = _sparse_words(rng, 2, 1024, density=0.004, multi_frac=0.1)
+    # a random "new" state: the changed bit's new value classifies the event
+    new = rng.integers(0, 2**32, chg.shape, dtype=np.uint32)
+    import jax.numpy as jnp
+
+    vals, gidx, cnt = extract_nonzero_words_segmented(
+        jnp.asarray(chg), 2048, n_seg)
+    nv = jnp.where(gidx >= 0,
+                   jnp.asarray(new).reshape(-1)[jnp.maximum(gidx, 0)],
+                   jnp.uint32(0))
+    bitpos, delta, base, gap_over, exc_vals, exc_new, exc_pos, exc_n = (
+        encode_word_stream(vals, gidx, cnt, nv))
+    dec_vals, dec_ent, dec_idx = decode_word_stream(
+        bitpos, delta, base, cnt, exc_vals, exc_pos, exc_new=exc_new,
+        with_enter=True)
+    flat_chg = chg.reshape(-1)
+    flat_new = new.reshape(-1)
+    order = np.argsort(dec_idx)
+    ref_idx = np.nonzero(flat_chg)[0]
+    assert (dec_idx[order] == ref_idx).all()
+    assert (dec_vals[order] == flat_chg[ref_idx]).all()
+    assert (dec_ent[order] == (flat_chg[ref_idx] & flat_new[ref_idx])).all()
+
+
+def test_stream_gap_overflow_flagged():
+    import jax.numpy as jnp
+
+    # two distant words in one segment: delta > 65535 must raise the flag
+    w = np.zeros(1 << 18, np.uint32)
+    w[10] = 4
+    w[200000] = 8
+    arr = jnp.asarray(w.reshape(1, 1024, 256))
+    vals, gidx, cnt = extract_nonzero_words_segmented(arr, 256, 1)
+    bitpos, delta, base, gap_over, exc_vals, exc_new, exc_pos, exc_n = (
+        encode_word_stream(vals, gidx, cnt))
+    assert bool(np.asarray(gap_over)[0])
+    dec_vals, dec_idx = decode_word_stream(
+        bitpos, delta, base, cnt, exc_vals, exc_pos,
+        fetch_gidx_row=lambda s: np.asarray(gidx[s]),
+        gap_over=np.asarray(gap_over))
+    assert list(dec_idx) == [10, 200000]
+    assert list(dec_vals) == [4, 8]
+
+
+def test_exception_stream_overflow_detectable():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(9)
+    words = _sparse_words(rng, 1, 1024, density=0.01, multi_frac=1.0)
+    jw = jnp.asarray(words)
+    vals, gidx, cnt = extract_nonzero_words_segmented(jw, 8192, 1)
+    out = encode_word_stream(vals, gidx, cnt, max_exc=16)
+    exc_n = int(out[7])
+    true_multi = int((np.bitwise_count(words.reshape(-1)) > 1).sum())
+    assert exc_n == true_multi and exc_n > 16  # caller sees the overflow
+
+
+def test_expand_classified_matches_expand():
+    from goworld_tpu.ops.events import (expand_classified_host,
+                                        expand_words_host)
+
+    rng = np.random.default_rng(12)
+    cap, s = 512, 2
+    words = _sparse_words(rng, s, cap, density=0.01, multi_frac=0.2)
+    flat = words.reshape(-1)
+    idx = np.nonzero(flat)[0]
+    vals = flat[idx]
+    new = rng.integers(0, 2**32, vals.shape, dtype=np.uint32)
+    ent_vals = vals & new
+    lv_vals = vals & ~new
+    pe, pl = expand_classified_host(vals, ent_vals, idx, cap, s)
+    ref_e = expand_words_host(ent_vals, idx, cap, s)
+    ref_l = expand_words_host(lv_vals, idx, cap, s)
+    assert (pe == ref_e).all() and (pl == ref_l).all()
