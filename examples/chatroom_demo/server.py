@@ -96,17 +96,13 @@ class Account(Entity):
 
     @rpc()
     def on_avatar_ready(self, avatar_id):
-        """Avatar answered: it is loaded on this or another game."""
+        """Avatar answered: it is loaded on this or another game.
+        give_client_to handles both: local fast path, or the cross-game
+        MT_GIVE_CLIENT_TO handoff (the gate switches its owner entity when
+        the avatar's is_player create arrives; the account entity then sees
+        on_client_disconnected and cleans itself up)."""
         self.logining = False
-        avatar = self.manager.entities.get(avatar_id)
-        if avatar is not None:
-            self.give_client_to(avatar)
-        else:
-            # avatar lives on another game: hand the client over via the
-            # gate-level owner switch after migrating there is the
-            # reference's path; simplest equivalent: tell the client to
-            # reconnect -- not needed on a single game in this demo
-            self.call_client("show_error", "avatar on another game")
+        self.give_client_to(avatar_id)
 
     def on_client_disconnected(self):
         self.destroy()

@@ -114,7 +114,7 @@ class DispatcherService:
             binutil.setup_http_server(self.dispcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
-        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S, self.log)
+        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S)
         self.log.info("dispatcher listening on %s", self.addr)
         return self
 
@@ -369,6 +369,25 @@ class DispatcherService:
 
     _h_call_entity_method_from_client = _h_call_entity_method
 
+    def _h_give_client_to(self, peer, pkt):
+        """Client handoff routes like an entity call (by target shard,
+        queued while the target loads/migrates) -- but a handoff for an eid
+        the directory hasn't learned yet must PARK, not drop: the source
+        game has already detached its client, so dropping would strand the
+        connection with no owner.  The park replays when the target's
+        MT_NOTIFY_CREATE_ENTITY lands (reference: MT_GIVE_CLIENT_TO +
+        dispatchPacket semantics, DispatcherService.go)."""
+        eid = pkt.read_entity_id()
+        ei = self.entities.get(eid)
+        if ei is None or ei.game_id == 0:
+            ei = self.entities.setdefault(eid, _EntityInfo())
+            if len(ei.pending) < BLOCKED_ENTITY_QUEUE_MAX:
+                ei.block_until = time.monotonic() + LOAD_BLOCK_TIMEOUT
+                ei.pending.append(pkt.payload)
+                self._blocked_eids.add(eid)
+            return
+        self._dispatch_entity_packet(eid, pkt)
+
     def _h_call_nil_spaces(self, peer, pkt):
         exclude = pkt.read_u16()
         for gid, gi in self.games.items():
@@ -515,6 +534,12 @@ class DispatcherService:
 
     def _unblock_entity(self, eid: str, ei: _EntityInfo):
         ei.block_until = 0.0
+        if ei.game_id == 0 and ei.pending:
+            # park expired without the entity ever registering: packets are
+            # undeliverable (give_client_to parks land here on timeout)
+            self.log.warning("dropping %d parked packets for unknown entity %s",
+                             len(ei.pending), eid)
+            ei.pending.clear()
         while ei.pending:
             payload = ei.pending.popleft()
             self._send_to_game(ei.game_id, Packet(bytearray(payload)))
@@ -597,6 +622,7 @@ class DispatcherService:
         MT.MT_LOAD_ENTITY_ANYWHERE: _h_load_entity_anywhere,
         MT.MT_CALL_ENTITY_METHOD: _h_call_entity_method,
         MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT: _h_call_entity_method_from_client,
+        MT.MT_GIVE_CLIENT_TO: _h_give_client_to,
         MT.MT_CALL_NIL_SPACES: _h_call_nil_spaces,
         MT.MT_SYNC_POSITION_YAW_FROM_CLIENT: _h_sync_from_client,
         MT.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE: _h_query_space_gameid_for_migrate,

@@ -120,7 +120,7 @@ class GameService:
             binutil.setup_http_server(self.gcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
-        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S, self.log)
+        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S)
         gwlog.announce_ready(f"game{self.id}", "game")
         return self
 
@@ -282,6 +282,23 @@ class GameService:
         gwutils.run_panicless(
             e.on_call_from_client, method, args, client_id, logger=self.log
         )
+
+    def _h_give_client_to(self, pkt):
+        """Receive client ownership for a local entity (reference:
+        GateService.go:263-294 -- the gate's owner_entity_id switches when
+        this entity's is_player create reaches it)."""
+        eid = pkt.read_entity_id()
+        client_id = pkt.read_client_id()
+        gate_id = pkt.read_u16()
+        e = self.rt.entities.get(eid)
+        if e is None:
+            self.log.warning("give_client_to: no entity %s (client %s orphaned)",
+                             eid, client_id)
+            return
+        old = e.client  # double handoff: the displaced client's teardown
+        e.set_client(GameClient(client_id, gate_id))
+        if old is not None:
+            self._flush_orphan_client(old)
 
     def _h_call_nil_spaces(self, pkt):
         _exclude = pkt.read_u16()
@@ -470,6 +487,7 @@ class GameService:
         MT.MT_NOTIFY_CLIENT_DISCONNECTED: _h_client_disconnected,
         MT.MT_CALL_ENTITY_METHOD: _h_call_entity_method,
         MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT: _h_call_entity_method_from_client,
+        MT.MT_GIVE_CLIENT_TO: _h_give_client_to,
         MT.MT_CALL_NIL_SPACES: _h_call_nil_spaces,
         MT.MT_SYNC_POSITION_YAW_FROM_CLIENT: _h_sync_from_client,
         MT.MT_CREATE_ENTITY_ANYWHERE: _h_create_entity_anywhere,
@@ -553,7 +571,36 @@ class GameService:
             if conn:
                 conn.send(p)
 
+    def _flush_orphan_client(self, cli: GameClient):
+        """Send the ops queued on a GameClient no longer bound to any entity
+        -- the per-tick outbox drain only visits clients reachable via an
+        entity, so detach/teardown ops would otherwise never leave."""
+        conn = self.cluster.by_gate(cli.gate_id)
+        if conn is not None:
+            for op in cli.outbox:
+                self._send_client_op(conn, cli, op)
+        cli.outbox.clear()
+
     # -- cluster-facing API for entities/user code -------------------------
+    def give_client_to(self, e: Entity, target_eid: str):
+        """Hand ``e``'s client to a (possibly remote) entity by id
+        (reference: GiveClientTo, Entity.go:752-765).  The local-target fast
+        path lives in Entity.give_client_to; this is the cross-game leg."""
+        cli = e.client
+        if cli is None:
+            return
+        # check the route before the irreversible detach: once the client is
+        # off this entity there is no local owner to fall back to
+        target = self.cluster.by_entity(target_eid)
+        if target is None:
+            self.log.warning(
+                "give_client_to: no route to %s's shard; keeping client on %s",
+                target_eid, e.id)
+            return
+        e.set_client(None)
+        self._flush_orphan_client(cli)
+        target.send_give_client_to(target_eid, cli.client_id, cli.gate_id)
+
     def call_entity(self, eid: str, method: str, *args):
         """Local fast path, else route via dispatcher (reference:
         EntityManager.Call, :429-442 + OPTIMIZE_LOCAL_ENTITY_CALL)."""

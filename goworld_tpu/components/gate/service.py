@@ -132,7 +132,7 @@ class GateService:
             )
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
-        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S, self.log)
+        opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S)
         gwlog.announce_ready(f"gate{self.id}", "gate")
         self.log.info("gate listening on %s", self.addr)
         return self

@@ -26,7 +26,9 @@ COMPONENT_QUEUE_MAX = 100_000
 BLOCKED_ENTITY_QUEUE_MAX = 1000      # reference: consts.go:32
 BLOCKED_GAME_QUEUE_MAX = 1_000_000   # reference: consts.go:30
 MIGRATE_BLOCK_TIMEOUT = 60.0         # reference: consts.go:71-77
-LOAD_BLOCK_TIMEOUT = 10.0
+LOAD_BLOCK_TIMEOUT = 60.0  # reference: DISPATCHER_LOAD_TIMEOUT 1 min,
+                           # consts.go:71-77 -- a slow storage load must keep
+                           # parked calls queued, not expire them early
 FREEZE_BLOCK_TIMEOUT = 10.0
 
 # persistence

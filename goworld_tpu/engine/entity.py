@@ -284,14 +284,26 @@ class Entity:
                 client.create_entity(other, is_player=False)
             self.on_client_connected()
 
-    def give_client_to(self, other: "Entity"):
-        """Move client ownership to another local entity (reference:
-        GiveClientTo, Entity.go:752-765; cross-game handoff via migration)."""
+    def give_client_to(self, other: "Entity | str"):
+        """Move client ownership to another entity -- local fast path, or
+        cross-game by entity id through MT_GIVE_CLIENT_TO (reference:
+        GiveClientTo, Entity.go:752-765; the client's gate switches its
+        owner when the target's is_player create arrives,
+        GateService.go:263-294)."""
         client = self.client
         if client is None:
             return
-        self.set_client(None)
-        other.set_client(client)
+        target = other if isinstance(other, Entity) else (
+            self.manager.entities.get(other))
+        if target is not None:
+            self.set_client(None)
+            target.set_client(client)
+            return
+        game = self.game
+        if game is None:
+            raise KeyError(f"give_client_to: no local entity {other!r} "
+                           "(not clustered)")
+        game.give_client_to(self, other)
 
     # -- space movement ----------------------------------------------------
     def enter_space(self, space_id: str, pos: Vector3 | None = None):

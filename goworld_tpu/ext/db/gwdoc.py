@@ -26,6 +26,12 @@ from ...utils.asyncjobs import JobError, OrderedWorker  # noqa: F401
 from ...engine.ids import gen_id
 
 
+class DuplicateKeyError(Exception):
+    """Insert with an _id that already exists in the collection (the
+    reference's gwmongo surfaces MongoDB's duplicate-key error the same
+    way; reference: ext/db/gwmongo/gwmongo.go Insert)."""
+
+
 # -- query/update evaluation -------------------------------------------------
 
 def _get_path(doc: dict, path: str):
@@ -203,11 +209,19 @@ class DocStore:
         with self._lock:
             doc = dict(doc)
             doc.setdefault("_id", gen_id())
-            self._db.execute(
-                "INSERT OR REPLACE INTO docs (col, id, data) VALUES (?,?,?)",
-                (col, str(doc["_id"]),
-                 msgpack.packb(doc, use_bin_type=True)),
-            )
+            # plain INSERT: a duplicate _id must fail loudly like MongoDB's
+            # duplicate-key error (reference: gwmongo Insert), not silently
+            # replace the existing document
+            try:
+                self._db.execute(
+                    "INSERT INTO docs (col, id, data) VALUES (?,?,?)",
+                    (col, str(doc["_id"]),
+                     msgpack.packb(doc, use_bin_type=True)),
+                )
+            except sqlite3.IntegrityError as e:
+                self._db.rollback()
+                raise DuplicateKeyError(
+                    f"duplicate _id {doc['_id']!r} in {col!r}") from e
             self._db.commit()
             return doc["_id"]
 
@@ -373,7 +387,15 @@ class PymongoEngine:
     def insert(self, col: str, doc: dict) -> str:
         doc = dict(doc)
         doc.setdefault("_id", gen_id())
-        self._db[col].replace_one({"_id": doc["_id"]}, doc, upsert=True)
+        # insert_one so a duplicate _id raises, re-raised as the local
+        # DuplicateKeyError so game code sees ONE type regardless of engine
+        import pymongo.errors
+
+        try:
+            self._db[col].insert_one(doc)
+        except pymongo.errors.DuplicateKeyError as e:
+            raise DuplicateKeyError(
+                f"duplicate _id {doc['_id']!r} in {col!r}") from e
         return doc["_id"]
 
     def find(self, col: str, query: dict | None = None,

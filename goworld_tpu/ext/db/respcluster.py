@@ -45,7 +45,13 @@ def key_slot(key: bytes | str) -> int:
 
 
 class RespClusterClient:
-    """Routes each command to the node owning its key's slot."""
+    """Routes each command to the node owning its key's slot.
+
+    Threading contract: ``command()`` (and the ``_conns`` pool behind it)
+    must be driven from ONE thread -- the storage/kvdb backends satisfy this
+    by owning the client from a single OrderedWorker.  Only ``_slot_map``
+    is lock-guarded, because ``_refresh_slots`` can be triggered from a
+    MOVED reply mid-command."""
 
     def __init__(self, startup_nodes: list[tuple[str, int]],
                  timeout: float = 10.0):

@@ -188,6 +188,25 @@ def kvdb_get_or_put(key: str, val: str, callback=None):
     current_game().kvdb.get_or_put(key, val, callback)
 
 
+# -- crontab ---------------------------------------------------------------
+
+def register_crontab(minute: int, hour: int, day: int, month: int,
+                     dayofweek: int, cb: Callable[[], None]) -> int:
+    """Register a minute-resolution cron callback on the game's crontab
+    (reference: goworld.RegisterCrontab, goworld.go:224-231;
+    engine/crontab/crontab.go:95-185).  Non-negative fields must match the
+    wall-clock value; ``-N`` means "every N".  Returns a handle for
+    :func:`unregister_crontab`.  Callbacks run panicless on the logic
+    thread."""
+    return current_game().rt.crontab.register(
+        minute, hour, day, month, dayofweek, cb)
+
+
+def unregister_crontab(handle: int) -> bool:
+    """Remove a crontab entry registered via :func:`register_crontab`."""
+    return current_game().rt.crontab.unregister(handle)
+
+
 # -- storage ---------------------------------------------------------------
 
 def exists_entity(type_name: str, eid: str, callback):

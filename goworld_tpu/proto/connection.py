@@ -113,6 +113,17 @@ class GWConnection:
         p.append_args(args)
         self.send(p)
 
+    def send_give_client_to(self, target_eid: str, client_id: str,
+                            gate_id: int):
+        """Hand client ownership to an entity on (possibly) another game;
+        routed by the TARGET's shard so a loading target queues the handoff
+        (reference: MT_GIVE_CLIENT_TO, Entity.go:752-765)."""
+        p = Packet.for_msgtype(MT.MT_GIVE_CLIENT_TO)
+        p.append_entity_id(target_eid)
+        p.append_client_id(client_id)
+        p.append_u16(gate_id)
+        self.send(p)
+
     def send_call_entity_method_from_client(
         self, eid: str, method: str, args: tuple, client_id: str
     ):

@@ -36,6 +36,10 @@ MT_QUERY_SPACE_GAMEID_FOR_MIGRATE = 30
 MT_MIGRATE_REQUEST = 31
 MT_REAL_MIGRATE = 32
 MT_CANCEL_MIGRATE = 33
+MT_GIVE_CLIENT_TO = 34  # game -> disp (by target eid shard) -> target's game:
+                        # target eid, client id, gate id (reference:
+                        # Entity.go:752-765, GateService.go:263-294 -- the
+                        # gate's owner switch rides the is_player create)
 
 # -- service discovery -----------------------------------------------------
 MT_SRVDIS_REGISTER = 40  # game -> disp: srvid, info

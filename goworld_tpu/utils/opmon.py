@@ -71,12 +71,15 @@ _dump_stop: threading.Event | None = None
 _dump_refs = 0
 
 
-def start_periodic_dump(interval: float, logger) -> None:
+def start_periodic_dump(interval: float) -> None:
     """Log the op table every ``interval`` seconds (reference: opmon's
     periodic dump, opmon.go:26-35,70-95).  Refcounted: components co-hosted
     in one process each start/stop it; the dumper thread runs while at
     least one is alive.  Each start gets its own stop event so
-    stop-then-start cannot leave a fresh thread observing a stale flag."""
+    stop-then-start cannot leave a fresh thread observing a stale flag.
+    The dump logs through a module-level logger: binding the first caller's
+    logger would misattribute every co-hosted component's ops to it (and
+    keep logging through a stopped component)."""
     global _dump_thread, _dump_stop, _dump_refs
     with _lock:
         _dump_refs += 1
@@ -87,6 +90,9 @@ def start_periodic_dump(interval: float, logger) -> None:
         _dump_stop = stop
 
         def run():
+            from . import gwlog
+
+            mod_log = gwlog.logger("opmon")
             while not stop.wait(interval):
                 table = dump()
                 if not table:
@@ -96,7 +102,7 @@ def start_periodic_dump(interval: float, logger) -> None:
                     f"  max {st['max_ms']:8.2f} ms"
                     for name, st in sorted(table.items())
                 ]
-                logger.info("opmon:\n%s", "\n".join(lines))
+                mod_log.info("opmon:\n%s", "\n".join(lines))
 
         # still inside _lock: a concurrent start must not spawn a second
         # dumper whose stop event was just orphaned

@@ -125,3 +125,28 @@ def test_facade_unbound():
     goworld.bind(None)
     with pytest.raises(RuntimeError):
         goworld.current_game()
+
+
+def test_facade_crontab(cluster):
+    """goworld.register_crontab reaches the runtime-ticked crontab
+    (reference: goworld.RegisterCrontab, goworld.go:224-231)."""
+    disp, (g1, g2) = cluster
+    fired = []
+    clock = [1_000_000 * 60.0]
+
+    # install the fake clock and register on the logic thread (crontab's
+    # documented contract: register from the logic thread only)
+    def setup():
+        g1.rt.crontab._wallclock = lambda: clock[0]
+        return goworld.register_crontab(
+            -1, -1, -1, -1, -1, lambda: fired.append(1))
+
+    handle = on_logic(g1, setup)
+    clock[0] += 60
+    assert _wait(lambda: len(fired) == 1), "crontab entry never fired"
+    clock[0] += 60
+    assert _wait(lambda: len(fired) == 2)
+    assert on_logic(g1, lambda: goworld.unregister_crontab(handle))
+    clock[0] += 60
+    time.sleep(0.2)
+    assert len(fired) == 2, "entry fired after unregister"

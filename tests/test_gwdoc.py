@@ -107,6 +107,19 @@ def test_docstore_crud(tmp_path):
     db.close()
 
 
+def test_docstore_duplicate_id_raises():
+    """Duplicate _id insert must fail loudly like MongoDB's duplicate-key
+    error (reference: gwmongo Insert), not silently replace."""
+    from goworld_tpu.ext.db.gwdoc import DuplicateKeyError
+
+    db = DocStore()
+    db.insert("c", {"_id": "x", "v": 1})
+    with pytest.raises(DuplicateKeyError):
+        db.insert("c", {"_id": "x", "v": 2})
+    assert db.find_id("c", "x")["v"] == 1  # original untouched
+    db.close()
+
+
 def test_docstore_persistence(tmp_path):
     path = str(tmp_path / "docs.sqlite")
     db = DocStore(path)
