@@ -12,14 +12,19 @@ Pipeline shape per config (the production wire format):
     a quarter of the wire cost of raw f32 positions.
   * Device: the fused Pallas kernel (goworld_tpu.ops.aoi_pallas) emits
     ``(new, changed)`` packed words; changed words are compacted by the
-    segmented two-level extraction and encoded to ~3 B/word (u8 bit
-    position + u16 index delta + exception stream -- ops/events.py).
+    chunk extraction (ops/events.py extract_chunks: one popcount pass, one
+    contiguous row gather of dirty 128-lane chunks, masked-reduction slot
+    selection -- NO per-element gathers, which made the earlier word-level
+    top_k extraction and its ``new``-value gather cost ~40 ms/tick at
+    8x8192) and encoded to ~5 B/chunk + 12 B/exception (encode_row_stream).
+    The NEW interest words ride the same chunk gather, so enter/leave
+    classification is free.
   * D2H: the encoded stream is sliced to the observed event density and
     fetched with ``copy_to_host_async`` while the next chunk computes.
-  * Host: decodes the stream, classifies enter vs leave by XOR-tracking the
-    previous interest words, and expands (space, observer, observed) event
-    pairs -- the exact stream the engine replays as onEnterAOI/onLeaveAOI
-    (reference: /root/reference/engine/entity/Entity.go:227-233).
+  * Host: decodes the stream and expands (space, observer, observed)
+    event pairs -- the exact stream the engine replays as
+    onEnterAOI/onLeaveAOI (reference:
+    /root/reference/engine/entity/Entity.go:227-233).
 
 ``device_ms_per_tick`` isolates the on-device portion; the e2e number pays
 this harness's network tunnel for every byte moved (a colocated deployment
@@ -40,7 +45,8 @@ import numpy as np
 STEP = 5.0
 QSCALE = np.float32(1.0 / 16.0)  # int8 delta unit: 1/16 world unit
 QMAX = int(STEP * 16)
-MAX_EXC = 1024
+MAX_EXC = 16384   # device cap on exception triples (tail + multi-bit words)
+MAX_GAPS = 2048   # device cap on escaped row deltas
 
 # knobs (headline config unless noted)
 S = int(os.environ.get("BENCH_SPACES", 8))
@@ -53,7 +59,8 @@ CPU_TICKS = int(os.environ.get("BENCH_CPU_TICKS", 3))
 REPS = int(os.environ.get("BENCH_REPS", 3))
 MAX_WORDS = int(os.environ.get("BENCH_MAX_WORDS", 0))  # 0 = auto-fit
 CONFIGS = os.environ.get(
-    "BENCH_CONFIGS", "unity1k,var_radius,uniform,zipf100k,million").split(",")
+    "BENCH_CONFIGS",
+    "unity1k,var_radius,zipf100k,million,engine,uniform").split(",")
 VERIFY = os.environ.get("BENCH_VERIFY", "") == "1"
 
 
@@ -90,6 +97,8 @@ def config_matrix():
         # double-buffer the 2.1 GB carry; 1-tick chunks measured faster)
         Config("million", 64, 16384, 11314.0, 100.0,
                ticks=3, chunk=1, reps=1, cpu_ticks=1),
+        # engine-level: Runtime.tick through the TPU bucket (host path)
+        Config("engine", S, CAP, WORLD, RADIUS, ticks=5),
         # headline: 8 spaces x 8192, uniform density (BASELINE "8 x 10k")
         Config("uniform", S, CAP, WORLD, RADIUS, headline=True),
     ]
@@ -150,21 +159,9 @@ def make_walk(cfg, rng, ticks):
     return qx, qz, xs, zs
 
 
-def pick_n_seg(total_words):
-    """Segments of ~256K words, at most 512 of them (power of two).
-
-    Measured at 8x8192 (16.7M words, ~85k changed/tick): the per-segment
-    two-level top_k is fastest around 256K-word segments (~5 ms/tick
-    extraction+encode vs ~14 ms at 4M-word segments and ~33 ms
-    unsegmented).  Past 512 segments (giant arrays) segments grow beyond
-    512K words instead, which flips ops/events.py to its cumsum+search
-    extraction -- binary-search lookups scale with slot count, so fewer,
-    tighter-capped segments win there."""
-    n = 1
-    while (total_words // n > (256 << 10) and n < 512
-           and total_words % (n * 2) == 0):
-        n *= 2
-    return n
+def fit_pow(v, mult):
+    """Round v up to a multiple of mult (at least mult)."""
+    return max(mult, -(-int(v) // mult) * mult)
 
 
 def bench_tpu(cfg, qx, qz, xs, zs):
@@ -174,35 +171,35 @@ def bench_tpu(cfg, qx, qz, xs, zs):
     from goworld_tpu.ops import words_per_row
     from goworld_tpu.ops.aoi_pallas import aoi_step_pallas
     from goworld_tpu.ops.events import (
-        decode_word_stream,
-        encode_word_stream,
+        decode_row_stream,
+        encode_row_stream,
         expand_classified_host,
-        extract_nonzero_words_segmented,
+        extract_chunks,
     )
 
     s, cap, world = cfg.s, cfg.cap, cfg.world
     w = words_per_row(cap)
-    total_words = s * cap * w
-    n_seg = int(os.environ.get("BENCH_NSEG", 0)) or pick_n_seg(total_words)
+    n_rows = s * cap
+    lanes = 128  # stream chunk width
+    n_stream_chunks = n_rows * w // lanes
     rng = np.random.default_rng(7)
     r = jnp.asarray(make_radius(cfg, rng))
     act_h = make_active(cfg)
     act = jnp.asarray(act_h)
     worldf = jnp.float32(world)
 
-    def make_run(mw):
+    def make_run(max_chunks, kcap):
         def step(carry, q):
             x, z, prev = carry
             qx_t, qz_t = q
             x = jnp.clip(x + qx_t.astype(jnp.float32) * QSCALE, 0.0, worldf)
             z = jnp.clip(z + qz_t.astype(jnp.float32) * QSCALE, 0.0, worldf)
             new, chg = aoi_step_pallas(x, z, r, act, prev, emit="chg")
-            vals, gidx, cnt = extract_nonzero_words_segmented(chg, mw, n_seg)
-            nv = jnp.where(gidx >= 0,
-                           new.reshape(-1)[jnp.maximum(gidx, 0)],
-                           jnp.uint32(0))
-            enc = encode_word_stream(vals, gidx, cnt, nv, max_exc=MAX_EXC)
-            return (x, z, new), (enc, cnt, vals, nv, gidx)
+            vals, nv, lane, csel, ccnt, nd, mcc = extract_chunks(
+                chg, max_chunks, kcap, aux=new, lanes=lanes)
+            enc = encode_row_stream(vals, nv, lane, csel, ccnt, w=lanes,
+                                    max_gaps=MAX_GAPS, max_exc=MAX_EXC)
+            return (x, z, new), (enc, nd, mcc, vals, nv, lane, csel)
 
         if chunk == 1:
             # giant-C configs: a 1-tick "chunk" without lax.scan avoids the
@@ -234,46 +231,67 @@ def bench_tpu(cfg, qx, qz, xs, zs):
     # warmup chunk (untimed): compiles the scan; true per-segment counts fix
     # the device-side cap and the D2H slice width (never clipped -- cnt is
     # the true count even past the cap)
-    mw = MAX_WORDS or min(total_words, max(8192, total_words // 256))
-    mw = max((mw // n_seg) * n_seg, n_seg)
-    run = make_run(mw)
+    # device caps: generous first guess, refit to observed density after the
+    # warmup chunk (n_dirty / max_ccnt are exact even past the caps)
+    max_chunks = MAX_WORDS or min(n_stream_chunks,
+                                  max(4096, n_stream_chunks // 8))
+    max_chunks = fit_pow(max_chunks, 512)
+    kcap = 8
+    run = make_run(max_chunks, kcap)
     wqx = jnp.asarray(qx[:chunk])
     wqz = jnp.asarray(qz[:chunk])
-    (wx, wz, wprev), (_, wcnt, _, _, _) = run(x0, z0, prev1, wqx, wqz)
-    peak_seg = int(np.asarray(wcnt).max())
+
+    def peaks(outs):
+        return (int(np.asarray(outs[1]).max()),        # n_dirty
+                int(np.asarray(outs[2]).max()),        # max_ccnt
+                int(np.asarray(outs[0][4]).max()),     # n_esc
+                int(np.asarray(outs[0][9]).max()))     # exc_n
+
+    (wx, wz, wprev), wouts = run(x0, z0, prev1, wqx, wqz)
+    peak_dirty, peak_ccnt, peak_esc, peak_exc = peaks(wouts)
     if VERIFY:
         assert (np.asarray(wx) == xs[chunk]).all(), "H2D delta walk diverged"
-    mws = mw // n_seg
-    fit = max(512, -(-int(peak_seg * 1.5) // 512) * 512)
-    if not MAX_WORDS and (peak_seg * 1.2 > mws or fit < mws):
-        mws = fit
-        mw = mws * n_seg
+    fit_chunks = min(n_stream_chunks, fit_pow(peak_dirty * 1.5, 512))
+    fit_k = min(lanes, fit_pow(peak_ccnt * 2, 2))
+    if not MAX_WORDS and (peak_dirty * 1.2 > max_chunks or peak_ccnt > kcap
+                          or fit_chunks < max_chunks):
+        max_chunks, kcap = fit_chunks, max(fit_k, 4)
         del wx, wz, wprev  # free the 3 big warmup buffers before re-running
-        run = make_run(mw)
-        (wx, wz, wprev), (_, wcnt, _, _, _) = run(x0, z0, prev1, wqx, wqz)
-        peak_seg = max(peak_seg, int(np.asarray(wcnt).max()))
-    del prev1  # only the post-warmup state is needed from here on
-    m = min(mws, max(128, -(-int(peak_seg * 1.15) // 128) * 128))
+        run = make_run(max_chunks, kcap)
+        (wx, wz, wprev), wouts = run(x0, z0, prev1, wqx, wqz)
+        pd2, pc2, ps2, px2 = peaks(wouts)
+        peak_dirty, peak_ccnt = max(peak_dirty, pd2), max(peak_ccnt, pc2)
+        peak_esc, peak_exc = max(peak_esc, ps2), max(peak_exc, px2)
+    del prev1, wouts  # only the post-warmup state is needed from here on
+    # D2H slices: chunk rows / escapes / exception triples shipped per tick
+    r_ship = min(max_chunks, fit_pow(peak_dirty * 1.15, 128))
+    esc_ship = min(MAX_GAPS, fit_pow((peak_esc + 1) * 1.5, 64))
+    exc_ship = min(MAX_EXC, fit_pow((peak_exc + 1) * 1.3, 256))
 
     # ONE D2H buffer per chunk -- every separate fetch pays a ~100 ms tunnel
     # round-trip, so the sliced stream and all sideband ints pack into a
-    # single u8 array.
-    meta_cols = 3 * n_seg + 3 * MAX_EXC + 1
+    # single u8 array.  Per dirty chunk 5 B: rowb u8 (index delta | slot
+    # count bit) + 2 inline slots x (bitpos u8 + lane u8); meta: scalars +
+    # escape rows + exception triples.
+    row_bytes = 1 + 2 * 2
+    meta_cols = 5 + esc_ship + 3 * exc_ship
 
     @jax.jit
-    def pack_chunk(bitpos, delta, cnt, base, gap_over, exc_vals, exc_new,
-                   exc_pos, exc_n):
-        bp = bitpos[..., :m]
-        d = delta[..., :m]
-        big = jnp.stack(
-            [bp, (d & 255).astype(jnp.uint8), (d >> 8).astype(jnp.uint8)],
-            axis=2)  # [chunk, n_seg, 3, m] u8
+    def pack_chunk(enc, nd, mcc):
+        (rowb, bitpos, woff, base_row, n_esc, esc_rows,
+         exc_gidx, exc_chg, exc_new, exc_n) = enc
+        big = jnp.concatenate([
+            rowb[:, :r_ship, None],
+            bitpos[:, :r_ship],
+            woff[:, :r_ship].astype(jnp.uint8),
+        ], axis=2)  # [chunk, r_ship, row_bytes] u8
         meta = jnp.concatenate([
-            cnt, base, gap_over.astype(jnp.int32),
-            exc_pos,
-            jax.lax.bitcast_convert_type(exc_vals, jnp.int32),
-            jax.lax.bitcast_convert_type(exc_new, jnp.int32),
-            exc_n[:, None],
+            base_row[:, None], nd[:, None], mcc[:, None],
+            n_esc[:, None], exc_n[:, None],
+            esc_rows[:, :esc_ship],
+            exc_gidx[:, :exc_ship],
+            jax.lax.bitcast_convert_type(exc_chg[:, :exc_ship], jnp.int32),
+            jax.lax.bitcast_convert_type(exc_new[:, :exc_ship], jnp.int32),
         ], axis=1)  # [chunk, meta_cols] i32
         ck = big.shape[0]
         return jnp.concatenate(
@@ -281,79 +299,67 @@ def bench_tpu(cfg, qx, qz, xs, zs):
              jax.lax.bitcast_convert_type(meta, jnp.uint8).reshape(ck, -1)],
             axis=1)
 
-    def harvest(enc_all, cnt_all):
-        (bitpos, delta, base, gap_over,
-         exc_vals, exc_new, exc_pos, exc_n) = enc_all
-        buf = pack_chunk(bitpos, delta, cnt_all, base, gap_over, exc_vals,
-                         exc_new, exc_pos, exc_n)
+    def harvest(outs):
+        buf = pack_chunk(outs[0], outs[1], outs[2])
         buf.copy_to_host_async()
         return buf
 
     # prev_host is only needed for the VERIFY integrity replay -- event
     # classification rides the stream's device-computed enter bits
-    prev_host = np.zeros(total_words, np.uint32) if VERIFY else None
+    prev_host = np.zeros(n_rows * w, np.uint32) if VERIFY else None
 
     def finish(harvested, kept, stats):
         bufh = np.asarray(harvested)
         ck = bufh.shape[0]
-        big_sz = n_seg * 3 * m
-        bh = bufh[:, :big_sz].reshape(ck, n_seg, 3, m)
+        big_sz = r_ship * row_bytes
+        bh = bufh[:, :big_sz].reshape(ck, r_ship, row_bytes)
         mh = bufh[:, big_sz:].view(np.int32)
-        bitpos_h = bh[:, :, 0]
-        delta_h = bh[:, :, 1].astype(np.uint16) | (
-            bh[:, :, 2].astype(np.uint16) << 8)
-        cnt_all = mh[:, :n_seg]
-        base = mh[:, n_seg:2 * n_seg]
-        gap_over = mh[:, 2 * n_seg:3 * n_seg].astype(bool)
-        exc_pos = mh[:, 3 * n_seg:3 * n_seg + MAX_EXC]
-        exc_vals = mh[:, 3 * n_seg + MAX_EXC:3 * n_seg + 2 * MAX_EXC].view(
-            np.uint32)
-        exc_new = mh[:, 3 * n_seg + 2 * MAX_EXC:3 * n_seg + 3 * MAX_EXC].view(
-            np.uint32)
-        exc_n = mh[:, -1]
-        vals_dev, nv_dev, gidx_dev = kept
+        vals_dev, nv_dev, lane_dev, csel_dev = kept
         full_cache = {}
 
-        def fetch_rows(t, which):
+        def fetch(t, which):
             if (t, which) not in full_cache:
                 src = {"vals": vals_dev, "new": nv_dev,
-                       "gidx": gidx_dev}[which]
+                       "lane": lane_dev, "csel": csel_dev}[which]
                 full_cache[(t, which)] = np.asarray(src[t])
             return full_cache[(t, which)]
 
-        for t in range(bitpos_h.shape[0]):
-            cnt_t = cnt_all[t]
-            over_seg = cnt_t > m  # slice overflow: decode from full rows
-            if int(exc_n[t]) > MAX_EXC or over_seg.any():
+        for t in range(ck):
+            ms = mh[t]
+            base_row, nd, mcc = int(ms[0]), int(ms[1]), int(ms[2])
+            n_esc, exc_n = int(ms[3]), int(ms[4])
+            if nd > max_chunks or mcc > kcap:
+                # device caps exceeded: events were lost on device
+                stats["overflow"] += 1
+                continue
+            if nd > r_ship or n_esc > esc_ship or exc_n > exc_ship:
+                # D2H slice too small for this tick: rebuild from the kept
+                # device-resident chunk grids (rare; ~MB-scale fetch)
                 stats["slow_path"] += 1
-                fv = fetch_rows(t, "vals")
-                fn = fetch_rows(t, "new")
-                fi = fetch_rows(t, "gidx")
-                vs, ns, gs = [], [], []
-                for si in range(n_seg):
-                    k = min(int(cnt_t[si]), fv.shape[1])
-                    if int(cnt_t[si]) > fv.shape[1]:
-                        stats["overflow"] += 1  # device cap exceeded
-                    vs.append(fv[si, :k])
-                    ns.append(fn[si, :k])
-                    gs.append(fi[si, :k])
-                chg_vals = np.concatenate(vs)
-                ent_vals = chg_vals & np.concatenate(ns)
-                chg_idx = np.concatenate(gs).astype(np.int64)
+                fv, fn = fetch(t, "vals"), fetch(t, "new")
+                fw, fr = fetch(t, "lane"), fetch(t, "csel")
+                valid = fw[:nd] >= 0
+                chg_vals = fv[:nd][valid]
+                ent_vals = chg_vals & fn[:nd][valid]
+                gidx = (fr[:nd, None].astype(np.int64) * lanes
+                        + fw[:nd])[valid]
             else:
-                go = gap_over[t]
-                if go.any():
-                    stats["slow_path"] += 1
-                chg_vals, ent_vals, chg_idx = decode_word_stream(
-                    bitpos_h[t], delta_h[t],
-                    base[t], cnt_t, exc_vals[t], exc_pos[t],
-                    exc_new=exc_new[t], exc_stride=mws,
-                    fetch_gidx_row=lambda si, _t=t: fetch_rows(_t, "gidx")[si],
-                    gap_over=go, with_enter=True)
+                esc_rows = ms[5:5 + esc_ship]
+                exc_gidx = ms[5 + esc_ship:5 + esc_ship + exc_ship]
+                exc_chg = ms[5 + esc_ship + exc_ship:
+                             5 + esc_ship + 2 * exc_ship].view(np.uint32)
+                exc_new = ms[5 + esc_ship + 2 * exc_ship:
+                             5 + esc_ship + 3 * exc_ship].view(np.uint32)
+                chg_vals, ent_vals, gidx = decode_row_stream(
+                    bh[t, :, 0], bh[t, :, 1:3],
+                    bh[t, :, 3:5].astype(np.uint16),
+                    base_row, nd, lanes,
+                    esc_rows, exc_gidx, exc_chg, exc_new)
             if prev_host is not None:
-                prev_host[chg_idx] ^= chg_vals
-            pe, pl = expand_classified_host(chg_vals, ent_vals, chg_idx,
-                                            cap, s)
+                # stream entries are whole words (unique indices), so a
+                # fancy-index XOR applies each exactly once
+                prev_host[gidx] ^= chg_vals
+            pe, pl = expand_classified_host(chg_vals, ent_vals, gidx, cap, s)
             stats["events"] += len(pe) + len(pl)
 
     def one_rep():
@@ -368,8 +374,7 @@ def bench_tpu(cfg, qx, qz, xs, zs):
         nxt = (jax.device_put(qx_meas[:chunk]), jax.device_put(qz_meas[:chunk]))
         for ci in range(n_chunks):
             qxc, qzc = nxt
-            carry, (enc, cnt_all, vals, nv, gidx) = run(
-                carry[0], carry[1], carry[2], qxc, qzc)
+            carry, outs = run(carry[0], carry[1], carry[2], qxc, qzc)
             if ci + 1 < n_chunks:
                 # enqueue the next chunk's H2D before host-side decode work
                 # so the transfer rides the wire while the device computes
@@ -378,7 +383,8 @@ def bench_tpu(cfg, qx, qz, xs, zs):
                        jax.device_put(qz_meas[lo:lo + chunk]))
             if pending is not None:
                 finish(pending[0], pending[1], rep_stats)
-            pending = (harvest(enc, cnt_all), (vals, nv, gidx))
+            pending = (harvest(outs),
+                       (outs[3], outs[4], outs[5], outs[6]))
         jax.block_until_ready(carry)
         t_device = time.perf_counter() - t0  # all compute drained
         finish(pending[0], pending[1], rep_stats)
@@ -431,8 +437,76 @@ def bench_tpu(cfg, qx, qz, xs, zs):
         "device_ms_per_tick": t_device / ticks * 1e3,
         "overflow_ticks": stats["overflow"],
         "slow_path_ticks": stats["slow_path"],
-        "slice_words": m * n_seg,
-        "n_seg": n_seg,
+        "slice_rows": r_ship,
+        "exc_ship": exc_ship,
+    }
+
+
+def bench_engine(cfg):
+    """Engine-level number: ``Runtime.tick`` through the TPU bucket with the
+    honest per-entity Python path -- ``set_position`` per entity, space slot
+    staging, one fused device flush, batched event replay through
+    ``_interest``/``_uninterest`` hooks, and the dirty-set sync phase.
+    This is the path a real game pays (reference equivalent: the per-move
+    ``aoiMgr.Moved`` + CollectEntitySyncInfos scan, Space.go:253-261 /
+    Entity.go:1221-1267); the ops-level configs above isolate the device
+    pipeline."""
+    import jax
+
+    from goworld_tpu.engine.entity import Entity
+    from goworld_tpu.engine.runtime import Runtime
+    from goworld_tpu.engine.space import Space
+    from goworld_tpu.engine.vector import Vector3
+
+    backend = "tpu" if jax.default_backend() == "tpu" else "cpp"
+
+    class BenchScene(Space):
+        pass
+
+    class BenchMob(Entity):
+        use_aoi = True
+        aoi_distance = cfg.radius
+
+    rt = Runtime(aoi_backend=backend)
+    rt.entities.register(BenchScene)
+    rt.entities.register(BenchMob)
+    rng = np.random.default_rng(3)
+    per = cfg.n_active // cfg.s
+    ents = []
+    for _si in range(cfg.s):
+        sp = rt.entities.create_space("BenchScene", kind=1)
+        sp.enable_aoi(cfg.radius)
+        for _ in range(per):
+            ents.append(rt.entities.create(
+                "BenchMob", space=sp,
+                pos=Vector3(rng.uniform(0, cfg.world), 0.0,
+                            rng.uniform(0, cfg.world))))
+    rt.tick()  # prime: mass-enter events replay (untimed)
+
+    n = len(ents)
+    ticks = cfg.ticks
+    wx = rng.uniform(-STEP, STEP, (ticks, n)).astype(np.float32)
+    wz = rng.uniform(-STEP, STEP, (ticks, n)).astype(np.float32)
+    pos = np.stack([np.array([e.position.x for e in ents], np.float32),
+                    np.array([e.position.z for e in ents], np.float32)])
+    t0 = time.perf_counter()
+    for t in range(ticks):
+        pos[0] = np.clip(pos[0] + wx[t], 0, cfg.world)
+        pos[1] = np.clip(pos[1] + wz[t], 0, cfg.world)
+        px, pz = pos[0], pos[1]
+        for i, e in enumerate(ents):
+            e.set_position(Vector3(px[i], 0.0, pz[i]))
+        rt.tick()
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "engine_moves_per_sec",
+        "value": round(n * ticks / dt),
+        "unit": "moves/s",
+        "config": "engine",
+        "detail": f"Runtime.tick via {backend} bucket, {cfg.s} spaces x "
+                  f"{per} entities, r={cfg.radius}, world={cfg.world}",
+        "ms_per_tick": round(dt / ticks * 1e3, 2),
+        "n_entities": n,
     }
 
 
@@ -487,26 +561,20 @@ def run_config(cfg):
         "events_per_tick": round(tpu["events_per_tick"]),
         "overflow_ticks": tpu["overflow_ticks"],
         "slow_path_ticks": tpu["slow_path_ticks"],
-        "slice_words": tpu["slice_words"],
-        "n_seg": tpu["n_seg"],
+        "slice_rows": tpu["slice_rows"],
+        "exc_ship": tpu["exc_ship"],
     }
 
 
 def main():
-    results = []
-    headline = None
+    # print each config's line as soon as it's measured (a killed run still
+    # records everything it finished); the headline runs LAST in the matrix
+    # so its line lands last either way
     for cfg in config_matrix():
         if cfg.name not in CONFIGS:
             continue
-        out = run_config(cfg)
-        if cfg.headline:
-            headline = out
-        else:
-            results.append(out)
-    for out in results:
+        out = bench_engine(cfg) if cfg.name == "engine" else run_config(cfg)
         print(json.dumps(out), flush=True)
-    if headline is not None:
-        print(json.dumps(headline), flush=True)
 
 
 if __name__ == "__main__":

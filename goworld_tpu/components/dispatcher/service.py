@@ -536,10 +536,25 @@ class DispatcherService:
         ei.block_until = 0.0
         if ei.game_id == 0 and ei.pending:
             # park expired without the entity ever registering: packets are
-            # undeliverable (give_client_to parks land here on timeout)
+            # undeliverable (give_client_to parks land here on timeout).  A
+            # dropped handoff strands a live, ownerless client connection --
+            # kick it at its gate so the player reconnects cleanly.
             self.log.warning("dropping %d parked packets for unknown entity %s",
                              len(ei.pending), eid)
-            ei.pending.clear()
+            while ei.pending:
+                payload = ei.pending.popleft()
+                pkt = Packet(bytearray(payload))
+                if pkt.read_u16() != MT.MT_GIVE_CLIENT_TO:
+                    continue
+                pkt.read_entity_id()  # target eid (the one that never came)
+                client_id = pkt.read_client_id()
+                gate_id = pkt.read_u16()
+                gate = self.gates.get(gate_id)
+                if gate is not None:
+                    out = Packet.for_msgtype(MT.MT_KICK_CLIENT)
+                    out.append_u16(gate_id)
+                    out.append_client_id(client_id)
+                    gate.send(out, release=True)
         while ei.pending:
             payload = ei.pending.popleft()
             self._send_to_game(ei.game_id, Packet(bytearray(payload)))
@@ -633,6 +648,7 @@ class DispatcherService:
         MT.MT_START_FREEZE_GAME: _h_start_freeze_game,
         MT.MT_CALL_FILTERED_CLIENTS: _h_call_filtered_clients,
         MT.MT_SET_CLIENTPROXY_FILTER_PROP: _h_set_filter_prop,
+        MT.MT_KICK_CLIENT: _h_set_filter_prop,  # same gate-id routing
         MT.MT_CLEAR_CLIENTPROXY_FILTER_PROPS: _h_clear_filter_props,
         MT.MT_GAME_LBC_INFO: _h_game_lbc_info,
     }

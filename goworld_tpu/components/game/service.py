@@ -73,6 +73,7 @@ class GameService:
         self._thread: threading.Thread | None = None
         self._registering_suppressed = False
         self._suppress_notify_eids: set[str] = set()
+        self._dirty_clients: set[GameClient] = set()
         self._lbc = LoadReporter()
         self.storage = None  # EntityStorageService, via attach_storage
         self.kvdb = None  # KVDBService, via attach_kvdb
@@ -251,14 +252,14 @@ class GameService:
             self.log.error("no boot_entity configured")
             return
         e = self.rt.entities.create(boot_type, eid=boot_eid)
-        e.set_client(GameClient(client_id, gate_id))
+        e.set_client(GameClient(client_id, gate_id, self._client_dirty))
 
     def _h_client_disconnected(self, pkt):
         client_id = pkt.read_client_id()
         owner_eid = pkt.read_entity_id()
         e = self.rt.entities.get(owner_eid)
         if e is not None and e.client is not None and e.client.client_id == client_id:
-            e.client = None
+            e.drop_client_ref()
             gwutils.run_panicless(e.on_client_disconnected, logger=self.log)
 
     def _h_call_entity_method(self, pkt):
@@ -292,11 +293,16 @@ class GameService:
         gate_id = pkt.read_u16()
         e = self.rt.entities.get(eid)
         if e is None:
-            self.log.warning("give_client_to: no entity %s (client %s orphaned)",
+            # the handoff target is gone: the client has no owner anywhere --
+            # kick it so it reconnects and gets a fresh boot entity
+            self.log.warning("give_client_to: no entity %s; kicking client %s",
                              eid, client_id)
+            conn = self.cluster.by_gate(gate_id)
+            if conn is not None:
+                conn.send_kick_client(gate_id, client_id)
             return
         old = e.client  # double handoff: the displaced client's teardown
-        e.set_client(GameClient(client_id, gate_id))
+        e.set_client(GameClient(client_id, gate_id, self._client_dirty))
         if old is not None:
             self._flush_orphan_client(old)
 
@@ -434,7 +440,9 @@ class GameService:
         data = pkt.read_data()
         client = data.get("client")
         e = self.rt.entities.restore(
-            data, client_factory=lambda cid, gid: GameClient(cid, gid)
+            data,
+            client_factory=lambda cid, gid: GameClient(
+                cid, gid, self._client_dirty)
         )
         space_id = data.get("target_space")
         sp = self.rt.entities.spaces.get(space_id) if space_id else None
@@ -455,7 +463,7 @@ class GameService:
         if e is None:
             return
         self.log.warning("destroying duplicate entity %s (lives elsewhere)", eid)
-        e.client = None  # the real entity owns the client
+        e.drop_client_ref()  # the real entity owns the client
         self._suppress_notify_eids.add(eid)
         try:
             gwutils.run_panicless(
@@ -473,7 +481,7 @@ class GameService:
         # detach all clients of that gate (reference: EntityManager.go:141-148)
         for e in list(self.rt.entities.entities.values()):
             if e.client is not None and e.client.gate_id == gate_id:
-                e.client = None
+                e.drop_client_ref()
                 gwutils.run_panicless(e.on_client_disconnected, logger=self.log)
 
     def _h_freeze_ack(self, pkt):
@@ -519,10 +527,17 @@ class GameService:
         if conn:
             conn.send_notify_destroy_entity(e.id)
 
+    def _client_dirty(self, cli: GameClient):
+        self._dirty_clients.add(cli)
+
     def _drain_client_outboxes(self):
-        for e in self.rt.entities.entities.values():
-            cli = e.client
-            if cli is None or not cli.outbox:
+        # only clients that queued ops since the last drain (GameClient
+        # registers itself via on_dirty; idle clients cost nothing per tick)
+        if not self._dirty_clients:
+            return
+        clients, self._dirty_clients = self._dirty_clients, set()
+        for cli in clients:
+            if not cli.outbox:
                 continue
             conn = self.cluster.by_gate(cli.gate_id)
             if conn is None:
@@ -738,12 +753,15 @@ class GameService:
                     member_pos[mid] = (d["id"], pos)
             for d in dump["entities"]:
                 e = self.rt.entities.restore(
-                    d, client_factory=lambda cid, gid: GameClient(cid, gid)
+                    d,
+                    client_factory=lambda cid, gid: GameClient(
+                        cid, gid, self._client_dirty)
                 )
                 # quiet client reattach: no re-create on the client
                 if e.client is not None:
                     e.client.outbox.clear()
                 e.quiet_interest_ticks = 1  # client already has its neighbors
+                e._mark_dirty()  # the dirty-set sync phase runs the countdown
                 where = member_pos.get(e.id)
                 if where is not None:
                     sp = id2space.get(where[0])

@@ -391,6 +391,15 @@ class GateService:
             for cp in tree.visit(op, value):
                 cp.send_payload(payload)
             return
+        if msgtype == MT.MT_KICK_CLIENT:
+            _gate_id = pkt.read_u16()
+            client_id = pkt.read_client_id()
+            cp = self.clients.get(client_id)
+            if cp is not None:
+                self.log.warning("kicking client %s (server request)",
+                                 client_id)
+                cp.pc.close()  # recv thread sees EOF -> client_gone teardown
+            return
         if msgtype == MT.MT_SET_CLIENTPROXY_FILTER_PROP:
             _gate_id = pkt.read_u16()
             client_id = pkt.read_client_id()

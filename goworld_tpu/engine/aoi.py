@@ -40,17 +40,51 @@ from ..ops import events as EV
 
 # A space handle is stable for the space's lifetime; slots inside a bucket are
 # reused after release.
-_MAX_EXTRACT_WORDS = 1 << 14
-
 
 _fused_impl = None  # built lazily: jax must not load in cpu-only processes
+_clear_impl = None
 
 
-def _fused_bucket_step(prev_all, slot_idx, x, z, r, act, mw):
+def _batched_clear(prev_all, row_slots, row_ents, col_slots, col_words,
+                   col_masks):
+    """Erase departed entities' rows and columns in ONE device dispatch.
+
+    A migration storm of k entities used to cost 2k sequential ``.at[].set``
+    dispatches before the kernel even ran; this scatters all row clears and
+    all (pre-combined per (slot, word)) column masks at once.  Callers pad
+    the index arrays by repeating a real entry -- both operations are
+    idempotent -- so compilation is per padded size, not per k.
+    """
+    global _clear_impl
+    if _clear_impl is None:
+        import functools
+
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def impl(prev_all, row_slots, row_ents, col_slots, col_words,
+                 col_masks):
+            prev_all = prev_all.at[row_slots, row_ents, :].set(0)
+            cols = prev_all[col_slots, :, col_words] & col_masks[:, None]
+            prev_all = prev_all.at[col_slots, :, col_words].set(cols)
+            return prev_all
+
+        _clear_impl = impl
+    return _clear_impl(prev_all, row_slots, row_ents, col_slots, col_words,
+                       col_masks)
+
+
+def _fused_bucket_step(prev_all, slot_idx, x, z, r, act, max_chunks, kcap):
     """One device program per bucket flush: gather staged slots' previous
     words, run the fused AOI kernel, scatter the new words back, and compact
-    both diffs -- a single dispatch instead of six (dispatch latency is per
-    tick on the production path)."""
+    the diff with the chunk extraction (ops/events.py extract_chunks -- no
+    per-element gathers; the NEW words ride the same chunk gather so
+    enter/leave classification is free).  A single dispatch instead of six
+    (dispatch latency is per tick on the production path).
+
+    Also returns ``chg``/``new`` so a cap-overflow tick can be recovered
+    host-side -- ``prev_all`` is donated, so the diff would otherwise be
+    unrecoverable."""
     global _fused_impl
     if _fused_impl is None:
         import functools
@@ -59,18 +93,17 @@ def _fused_bucket_step(prev_all, slot_idx, x, z, r, act, mw):
 
         from ..ops.aoi_pallas import aoi_step_pallas
 
-        @functools.partial(jax.jit, static_argnames=("mw",),
+        @functools.partial(jax.jit, static_argnames=("max_chunks", "kcap"),
                            donate_argnums=(0,))
-        def impl(prev_all, slot_idx, x, z, r, act, mw):
+        def impl(prev_all, slot_idx, x, z, r, act, max_chunks, kcap):
             prev_rows = prev_all[slot_idx]
-            new, ent, lv = aoi_step_pallas(x, z, r, act, prev_rows)
+            new, chg = aoi_step_pallas(x, z, r, act, prev_rows, emit="chg")
             prev_all = prev_all.at[slot_idx].set(new)
-            return (prev_all, ent, lv,
-                    EV.extract_nonzero_words(ent, mw),
-                    EV.extract_nonzero_words(lv, mw))
+            ex = EV.extract_chunks(chg, max_chunks, kcap, aux=new, lanes=128)
+            return prev_all, new, chg, ex
 
         _fused_impl = impl
-    return _fused_impl(prev_all, slot_idx, x, z, r, act, mw)
+    return _fused_impl(prev_all, slot_idx, x, z, r, act, max_chunks, kcap)
 
 
 @dataclass
@@ -315,6 +348,10 @@ class _TPUBucket(_Bucket):
         self.prev = None  # [S, C, W] uint32 device array
         self._pending_reset: set[int] = set()
         self._pending_clear: list[tuple[int, int]] = []  # (slot, entity_slot)
+        # adaptive extraction caps; a tick that exceeds them is recovered
+        # host-side from the full diff and the caps grow for the next tick
+        self._max_chunks = 4096
+        self._kcap = 8
 
     def _grow_to(self, n_slots: int) -> None:
         jnp = self._jnp
@@ -345,12 +382,36 @@ class _TPUBucket(_Bucket):
             self.prev = self.prev.at[idx].set(jnp.uint32(0))
             self._pending_reset.clear()
         if self._pending_clear:
+            # combine repeated (slot, word) column masks host-side so the
+            # scatter indices are unique, then apply everything in ONE
+            # dispatch (k clears used to cost 2k round trips)
+            col_mask: dict[tuple[int, int], int] = {}
+            rows = []
             for slot, e in self._pending_clear:
                 w, b = P.word_bit_for_column(e, c)
-                mask = jnp.uint32(~(1 << b) & 0xFFFFFFFF)
-                self.prev = self.prev.at[slot, e, :].set(jnp.uint32(0))
-                self.prev = self.prev.at[slot, :, w].set(self.prev[slot, :, w] & mask)
+                key = (slot, w)
+                col_mask[key] = col_mask.get(key, 0xFFFFFFFF) & (
+                    ~(1 << b) & 0xFFFFFFFF)
+                rows.append((slot, e))
             self._pending_clear.clear()
+            cols = [(s, w, m) for (s, w), m in col_mask.items()]
+
+            def pad(seq):  # repeat the last entry up to a power of two
+                n = 1
+                while n < len(seq):
+                    n *= 2
+                return seq + [seq[-1]] * (n - len(seq))
+
+            rows = pad(rows)
+            cols = pad(cols)
+            self.prev = _batched_clear(
+                self.prev,
+                jnp.asarray([s for s, _ in rows], jnp.int32),
+                jnp.asarray([e for _, e in rows], jnp.int32),
+                jnp.asarray([s for s, _, _ in cols], jnp.int32),
+                jnp.asarray([w for _, w, _ in cols], jnp.int32),
+                jnp.asarray([m for _, _, m in cols], jnp.uint32),
+            )
         if not self._staged:
             return
 
@@ -370,17 +431,40 @@ class _TPUBucket(_Bucket):
         self._staged.clear()
 
         slot_idx = jnp.asarray(slots, jnp.int32)
-        self.prev, ent, lv, ee, le = _fused_bucket_step(
+        n_chunks_total = s_n * c * self.W // 128
+        mc = min(self._max_chunks, max(n_chunks_total, 512))
+        self.prev, new, chg, ex = _fused_bucket_step(
             self.prev, slot_idx, jnp.asarray(x), jnp.asarray(z),
-            jnp.asarray(r), jnp.asarray(act), _MAX_EXTRACT_WORDS
+            jnp.asarray(r), jnp.asarray(act), mc, self._kcap
         )
-        # one overlapped D2H burst instead of six sequential fetches -- the
-        # dev harness reaches the chip over a network tunnel where every
-        # synchronous fetch pays a round trip
-        for arr in (*ee, *le):
-            arr.copy_to_host_async()
-        ent_rows = self._expand(ee, ent, s_n)
-        lv_rows = self._expand(le, lv, s_n)
+        vals, nv, lane, csel, ccnt, nd_d, mcc_d = ex
+        nd, mcc = int(nd_d), int(mcc_d)
+        if nd > mc or mcc > self._kcap:
+            # caps exceeded: recover this tick from the full diff, then grow
+            # the caps so the next tick extracts on device again
+            self._max_chunks = max(self._max_chunks, 2 * nd)
+            self._kcap = max(self._kcap, 2 * mcc)
+            chg_h = np.asarray(chg).reshape(-1)
+            new_h = np.asarray(new).reshape(-1)
+            gidx = np.nonzero(chg_h)[0]
+            chg_vals = chg_h[gidx]
+            ent_vals = chg_vals & new_h[gidx]
+        else:
+            # fetch only the dirty prefix (padded to a stable shape), with
+            # the four transfers overlapped -- each synchronous fetch pays a
+            # round trip when the chip is reached over a network tunnel
+            ndp = min(mc, -(-max(nd, 1) // 512) * 512)
+            slices = (vals[:ndp], nv[:ndp], lane[:ndp], csel[:ndp])
+            for a in slices:
+                a.copy_to_host_async()
+            vh, nh, lh, ch = (np.asarray(a) for a in slices)
+            valid = lh >= 0
+            chg_vals = vh[valid]
+            ent_vals = chg_vals & nh[valid]
+            gidx = (ch[:, None].astype(np.int64) * 128 + lh)[valid]
+        pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx, c, s_n)
+        ent_rows = self._split_rows(pe)
+        lv_rows = self._split_rows(pl)
         empty = np.empty((0, 2), np.int32)
         for row, slot in enumerate(slots):
             e = ent_rows.get(row, empty)
@@ -399,27 +483,9 @@ class _TPUBucket(_Bucket):
         self._pending_reset.discard(slot)
         self.prev = self.prev.at[slot].set(self._jnp.asarray(words, self._jnp.uint32))
 
-    def _expand(self, extracted, words, s_n: int) -> dict[int, np.ndarray]:
-        """Host-side expansion of one diff's device-extracted words; falls
-        back to downloading the full diff on (rare) extraction overflow."""
-        vals, flat_idx, nz = extracted
-        if int(nz) > _MAX_EXTRACT_WORDS:
-            # Rare overflow: download the whole bucket's diff and expand host-side.
-            host = np.asarray(words)
-            triples = []
-            for s in range(s_n):
-                p = P.pairs_from_words(host[s], self.capacity)
-                if len(p):
-                    triples.append(
-                        np.concatenate([np.full((len(p), 1), s, np.int32), p], axis=1)
-                    )
-            tri = (
-                np.concatenate(triples)
-                if triples
-                else np.empty((0, 3), np.int32)
-            )
-        else:
-            tri = EV.expand_words_host(vals, flat_idx, self.capacity, s_n)
+    @staticmethod
+    def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:
+        """(space_row, i, j) triples -> {space_row: (i, j) pairs}."""
         out: dict[int, np.ndarray] = {}
         if len(tri):
             for s in np.unique(tri[:, 0]):

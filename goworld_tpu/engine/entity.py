@@ -40,16 +40,24 @@ class GameClient:
     outbox is inspected directly.
     """
 
-    __slots__ = ("client_id", "gate_id", "outbox")
+    __slots__ = ("client_id", "gate_id", "outbox", "on_dirty")
 
-    def __init__(self, client_id: str, gate_id: int = 0):
+    def __init__(self, client_id: str, gate_id: int = 0, on_dirty=None):
         self.client_id = client_id
         self.gate_id = gate_id
         self.outbox: list[tuple] = []
+        # called on the first op after each drain, so the host component
+        # visits only clients with traffic (no per-tick all-entities scan)
+        self.on_dirty = on_dirty
+
+    def _push(self, op: tuple):
+        if not self.outbox and self.on_dirty is not None:
+            self.on_dirty(self)
+        self.outbox.append(op)
 
     # -- ops toward the client (batched) ----------------------------------
     def create_entity(self, e: "Entity", is_player: bool):
-        self.outbox.append(
+        self._push(
             (
                 "create_entity",
                 e.type_name,
@@ -62,13 +70,13 @@ class GameClient:
         )
 
     def destroy_entity(self, e: "Entity"):
-        self.outbox.append(("destroy_entity", e.type_name, e.id))
+        self._push(("destroy_entity", e.type_name, e.id))
 
     def attr_delta(self, eid: str, path: tuple, op: str, value: Any):
-        self.outbox.append(("attr_delta", eid, path, op, value))
+        self._push(("attr_delta", eid, path, op, value))
 
     def call_client(self, eid: str, method: str, args: tuple):
-        self.outbox.append(("call", eid, method, args))
+        self._push(("call", eid, method, args))
 
 
 class Entity:
@@ -100,6 +108,11 @@ class Entity:
         self.aoi_slot: int = -1  # slot in the space's arrays while in a space
         self.interested_in: set[Entity] = set()
         self.interested_by: set[Entity] = set()
+        # how many of interested_by have a client -- maintained by
+        # _interest/_uninterest/set_client so the sync phase can skip the
+        # neighbor fanout for entities nobody's client is watching (the
+        # common case: server-side mobs far from any player)
+        self._watcher_clients = 0
         self.client: GameClient | None = None
         self.client_syncing = False  # accept client-originated position sync
         self._timer_ids: dict[int, tuple] = {}  # tid -> (method, interval, repeat, args)
@@ -113,6 +126,15 @@ class Entity:
         self.quiet_interest_ticks = 0
 
     # ------------------------------------------------------------------ api
+    def _mark_dirty(self):
+        """Register with the runtime's per-tick dirty set so the sync phase
+        touches only entities that actually changed (the reference's
+        CollectEntitySyncInfos scans every entity each tick, Entity.go:1221
+        -- compiled Go affords that; a host-language tick loop does not)."""
+        m = self.manager
+        if m is not None:
+            m.runtime._dirty_entities.add(self)
+
     @property
     def is_space(self) -> bool:
         return False
@@ -166,6 +188,7 @@ class Entity:
     # -- attrs ------------------------------------------------------------
     def _on_attr_delta(self, path: tuple, op: str, value: Any):
         self._attr_deltas.append((path, op, value))
+        self._mark_dirty()
 
     def client_visible_attrs(self, to_owner: bool) -> dict:
         """Snapshot of attrs visible to a client (own client sees ``client``
@@ -219,12 +242,14 @@ class Entity:
         if not self.client_syncing:
             # server-driven move must also correct the owner client
             self._sync_flags |= SYNC_OWN
+        self._mark_dirty()
 
     def set_yaw(self, yaw: float):
         self.yaw = float(yaw)
         self._sync_flags |= SYNC_NEIGHBORS
         if not self.client_syncing:
             self._sync_flags |= SYNC_OWN
+        self._mark_dirty()
 
     def set_client_syncing(self, flag: bool):
         """Allow the owner client to drive this entity's position
@@ -237,6 +262,7 @@ class Entity:
         self.space.move_entity(self, pos)
         self.yaw = float(yaw)
         self._sync_flags |= SYNC_NEIGHBORS
+        self._mark_dirty()
 
     # interest bookkeeping -- driven by the space's batched AOI events
     # (reference: interest/uninterest, Entity.go:236-246)
@@ -247,6 +273,8 @@ class Entity:
         quiet = self.quiet_interest_ticks > 0
         if self.client is not None and not quiet:
             other._flush_attr_deltas()
+        if other not in self.interested_in and self.client is not None:
+            other._watcher_clients += 1
         self.interested_in.add(other)
         other.interested_by.add(self)
         if self.client is not None and not quiet:
@@ -254,6 +282,8 @@ class Entity:
         self.on_enter_aoi(other)
 
     def _uninterest(self, other: "Entity"):
+        if other in self.interested_in and self.client is not None:
+            other._watcher_clients -= 1
         self.interested_in.discard(other)
         other.interested_by.discard(self)
         if self.client is not None and self.quiet_interest_ticks == 0:
@@ -264,15 +294,29 @@ class Entity:
         return self.interested_in
 
     # -- client binding ----------------------------------------------------
+    def drop_client_ref(self):
+        """Detach the client WITHOUT emitting client ops -- the connection is
+        already gone (peer disconnect, duplicate-entity teardown).  Keeps the
+        _watcher_clients bookkeeping consistent, which raw ``e.client = None``
+        assignments would silently corrupt."""
+        if self.client is None:
+            return
+        for other in self.interested_in:
+            other._watcher_clients -= 1
+        self.client = None
+
     def set_client(self, client: GameClient | None):
         old = self.client
         if old is not None:
             old.destroy_entity(self)
             for other in self.interested_in:
                 old.destroy_entity(other)
+                other._watcher_clients -= 1
             self.client = None
             self.on_client_disconnected()
         if client is not None:
+            for other in self.interested_in:
+                other._watcher_clients += 1
             # flush pending deltas to the old audiences first -- the
             # snapshots below already contain them (see _interest)
             self._flush_attr_deltas()

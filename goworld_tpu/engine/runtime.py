@@ -45,6 +45,10 @@ class Runtime:
         self.aoi = AOIEngine(default_backend=aoi_backend)
         self.entities = EntityManager(self)
         self.tick_count = 0
+        # entities with pending sync flags / attr deltas / quiet countdowns;
+        # the sync phase walks ONLY these (reference scans every entity each
+        # tick -- Entity.go:1221-1267 -- which compiled Go affords)
+        self._dirty_entities: set[Entity] = set()
         # position sync records collected this tick:
         # (client_id, gate_id, entity_id, x, y, z, yaw)
         self.sync_out: list[tuple] = []
@@ -80,8 +84,15 @@ class Runtime:
                 sp.dispatch_aoi_events()
 
     def _sync_phase(self):
-        """Collect position sync + flush attr deltas, batched per tick."""
-        for e in self.entities.entities.values():
+        """Collect position sync + flush attr deltas for DIRTY entities only
+        (entities self-register via Entity._mark_dirty; idle entities cost
+        nothing per tick)."""
+        if not self._dirty_entities:
+            return
+        dirty, self._dirty_entities = self._dirty_entities, set()
+        for e in dirty:
+            if e.destroyed:
+                continue
             if e._sync_flags:
                 self._collect_sync(e)
                 e._sync_flags = 0
@@ -89,6 +100,8 @@ class Runtime:
                 e._flush_attr_deltas()
             if e.quiet_interest_ticks:
                 e.quiet_interest_ticks -= 1
+                if e.quiet_interest_ticks:
+                    self._dirty_entities.add(e)
 
     def _collect_sync(self, e: Entity):
         """One 16-byte-payload record per flagged entity per tick
@@ -99,7 +112,7 @@ class Runtime:
             self.sync_out.append(
                 (e.client.client_id, e.client.gate_id, e.id, x, y, z, e.yaw)
             )
-        if flags & SYNC_NEIGHBORS:
+        if flags & SYNC_NEIGHBORS and e._watcher_clients > 0:
             for other in e.interested_by:
                 if other.client is not None:
                     self.sync_out.append(

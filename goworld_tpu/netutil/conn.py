@@ -54,7 +54,11 @@ class FrameParser:
                 try:
                     payload = self._compressor.decompress(payload)
                 except Exception as e:  # zlib.error is not a ValueError
-                    raise ValueError(f"corrupt compressed frame: {e}") from e
+                    raise ValueError(
+                        f"corrupt compressed frame: {e} "
+                        f"(size={size}, codec={self._compressor.name}, "
+                        f"head={payload[:32].hex()})"
+                    ) from e
             p = Packet(bytearray(payload))
             out.append(p)
         return out
@@ -105,9 +109,18 @@ class PacketConnection:
                         continue
                 out += _u32.pack(len(payload))
                 out += payload
+            # A timed-out sendall leaves a PARTIAL frame on the wire and
+            # permanently desyncs the peer's parser (sendall's documented
+            # undefined-state caveat), so the write itself must always run
+            # blocking; the caller's timeout is restored for recv use.
+            timeout = self._sock.gettimeout()
+            if timeout is not None:
+                self._sock.settimeout(None)
             try:
                 self._sock.sendall(out)
             finally:
+                if timeout is not None:
+                    self._sock.settimeout(timeout)
                 op.finish()
             return len(out)
 

@@ -224,6 +224,9 @@ class KCPSession:
     def settimeout(self, t: float | None) -> None:
         self._timeout = t
 
+    def gettimeout(self) -> float | None:
+        return self._timeout
+
     def setsockopt(self, *args) -> None:
         pass
 

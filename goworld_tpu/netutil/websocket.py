@@ -253,3 +253,6 @@ class WSSocket:
 
     def settimeout(self, t) -> None:
         self._sock.settimeout(t)
+
+    def gettimeout(self):
+        return self._sock.gettimeout()

@@ -263,6 +263,14 @@ class GWConnection:
         self.send(p)
 
     # -- filtered clients --------------------------------------------------
+    def send_kick_client(self, gate_id: int, client_id: str):
+        """Close a client's connection at its gate (MT_KICK_CLIENT): the
+        recovery for a client left ownerless by a failed GiveClientTo."""
+        p = Packet.for_msgtype(MT.MT_KICK_CLIENT)
+        p.append_u16(gate_id)
+        p.append_client_id(client_id)
+        self.send(p)
+
     def send_set_clientproxy_filter_prop(self, gate_id: int, client_id: str,
                                          key: str, value: str):
         p = Packet.for_msgtype(MT.MT_SET_CLIENTPROXY_FILTER_PROP)

@@ -69,6 +69,9 @@ MT_REDIRECT_TO_CLIENT_END = 1499
 MT_CALL_FILTERED_CLIENTS = 1501          # game -> disp -> ALL gates
 MT_SET_CLIENTPROXY_FILTER_PROP = 1502    # game -> disp -> owning gate
 MT_CLEAR_CLIENTPROXY_FILTER_PROPS = 1503
+MT_KICK_CLIENT = 1504                    # game/disp -> gate: close the client
+#   connection (e.g. a GiveClientTo whose target never materialized -- the
+#   ownerless client must reconnect rather than hang on a dead owner)
 MT_GATE_SERVICE_END = 1999
 
 # -- gate <-> client direct ------------------------------------------------

@@ -1,0 +1,156 @@
+"""Parity tests for the chunk-compacted extraction + row-stream wire codec
+(ops/events.py: extract_chunks / encode_row_stream / decode_row_stream) --
+the device->host event path the AOI bench ships.
+
+Reference semantics being preserved: the packed-words diff must reach the
+host bit-exactly so enter/leave callbacks replay deterministically
+(reference: /root/reference/engine/entity/Entity.go:227-246).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from goworld_tpu.ops.events import (  # noqa: E402
+    decode_row_stream,
+    encode_row_stream,
+    extract_chunks,
+)
+
+LANES = 128
+
+
+def _random_words(rng, shape, n_dirty_words, multi_frac=0.2):
+    total = int(np.prod(shape))
+    words = np.zeros(total, np.uint32)
+    idx = rng.choice(total, n_dirty_words, replace=False)
+    for i in idx:
+        bits = 1 + int(rng.random() < multi_frac) * int(rng.integers(1, 4))
+        v = 0
+        for _ in range(bits):
+            v |= 1 << int(rng.integers(0, 32))
+        words[i] = v
+    return words.reshape(shape)
+
+
+def _reference_stream(chg, new):
+    """(chg_word_value, ent_word_value, global_word_index) of every nonzero
+    changed word, ascending -- what decode must reproduce (as a set: the
+    codec may split a word between inline slot and exception stream, but
+    here each word appears exactly once in either)."""
+    flat = chg.reshape(-1)
+    nflat = new.reshape(-1)
+    gidx = np.nonzero(flat)[0]
+    return flat[gidx], flat[gidx] & nflat[gidx], gidx
+
+
+def _roundtrip(chg, new, max_chunks=512, k=8, max_gaps=64, max_exc=256):
+    vals, nv, lane, csel, ccnt, nd, mcc = jax.tree.map(
+        np.asarray,
+        extract_chunks(jax.numpy.asarray(chg), max_chunks, k,
+                       aux=jax.numpy.asarray(new), lanes=LANES))
+    assert int(nd) <= max_chunks and int(mcc) <= k, "test sized too small"
+    enc = jax.tree.map(np.asarray, encode_row_stream(
+        jax.numpy.asarray(vals), jax.numpy.asarray(nv),
+        jax.numpy.asarray(lane), jax.numpy.asarray(csel),
+        jax.numpy.asarray(ccnt), w=LANES, max_gaps=max_gaps,
+        max_exc=max_exc))
+    (rowb, bitpos, woff, base_row, n_esc, esc_rows,
+     exc_gidx, exc_chg, exc_new, exc_n) = enc
+    assert int(n_esc) <= max_gaps and int(exc_n) <= max_exc
+    return decode_row_stream(rowb, bitpos, woff.astype(np.uint16),
+                             int(base_row), int(nd), LANES,
+                             esc_rows, exc_gidx, exc_chg, exc_new)
+
+
+def _check(chg, new, **kw):
+    got_c, got_e, got_g = _roundtrip(chg, new, **kw)
+    ref_c, ref_e, ref_g = _reference_stream(chg, new)
+    order = np.argsort(got_g, kind="stable")
+    assert np.array_equal(got_g[order], ref_g)
+    assert np.array_equal(got_c[order], ref_c)
+    assert np.array_equal(got_e[order], ref_e)
+
+
+def test_roundtrip_sparse_uniform():
+    rng = np.random.default_rng(0)
+    chg = _random_words(rng, (4, 64, 32), 300)
+    new = rng.integers(0, 1 << 32, chg.shape, dtype=np.uint64).astype(
+        np.uint32)
+    _check(chg, new, k=16)
+
+
+def test_roundtrip_dense_rows_and_multibit():
+    rng = np.random.default_rng(1)
+    # heavy multi-bit mix exercises the exception stream
+    chg = _random_words(rng, (2, 32, 64), 500, multi_frac=0.8)
+    new = rng.integers(0, 1 << 32, chg.shape, dtype=np.uint64).astype(
+        np.uint32)
+    _check(chg, new, k=32, max_exc=1024)
+
+
+def test_roundtrip_row_delta_escapes():
+    # two dirty chunks very far apart force the 6-bit delta escape
+    chg = np.zeros((1, 512, 128), np.uint32)
+    chg[0, 0, 0] = 1
+    chg[0, 511, 127] = 1 << 31
+    new = np.zeros_like(chg)
+    new[0, 511, 127] = 1 << 31  # second word is an enter
+    got_c, got_e, got_g = _roundtrip(chg, new)
+    assert list(got_g) == [0, 512 * 128 - 1]
+    assert list(got_c) == [1, 1 << 31]
+    assert list(got_e) == [0, 1 << 31]
+
+
+def test_roundtrip_empty():
+    chg = np.zeros((2, 64, 32), np.uint32)
+    got_c, got_e, got_g = _roundtrip(chg, np.zeros_like(chg))
+    assert len(got_c) == 0 and len(got_g) == 0
+
+
+def test_tail_words_beyond_inline_slots():
+    # one chunk with 5 changed words: 2 inline + 3 exception entries
+    chg = np.zeros((1, 8, 128), np.uint32)
+    for lane in (3, 10, 50, 90, 120):
+        chg[0, 2, lane] = 1 << (lane % 32)
+    new = chg.copy()  # all enters
+    got_c, got_e, got_g = _roundtrip(chg, new)
+    assert len(got_g) == 5
+    order = np.argsort(got_g)
+    assert np.array_equal(np.sort(got_g), got_g[order])
+    assert np.array_equal(got_c[order], got_e[order])  # every bit an enter
+
+
+def test_overflow_scalars_exact_past_caps():
+    rng = np.random.default_rng(2)
+    chg = _random_words(rng, (1, 64, 128), 600)
+    vals, nv, lane, csel, ccnt, nd, mcc = jax.tree.map(
+        np.asarray,
+        extract_chunks(jax.numpy.asarray(chg), 16, 2, lanes=LANES))
+    flat = chg.reshape(-1, LANES)
+    true_dirty = int((flat != 0).any(axis=1).sum())
+    true_max = int((flat != 0).sum(axis=1).max())
+    assert int(nd) == true_dirty  # exact even though 16 < true_dirty
+    assert int(mcc) == true_max
+
+
+def test_expand_classified_matches_expand():
+    from goworld_tpu.ops.events import (expand_classified_host,
+                                        expand_words_host)
+
+    rng = np.random.default_rng(12)
+    cap, s = 512, 2
+    words = _random_words(rng, (s, 512, 16), 160, multi_frac=0.2)
+    flat = words.reshape(-1)
+    idx = np.nonzero(flat)[0]
+    vals = flat[idx]
+    new = rng.integers(0, 2**32, vals.shape, dtype=np.uint64).astype(np.uint32)
+    ent_vals = vals & new
+    lv_vals = vals & ~new
+    pe, pl = expand_classified_host(vals, ent_vals, idx, cap, s)
+    ref_e = expand_words_host(ent_vals, idx, cap, s)
+    ref_l = expand_words_host(lv_vals, idx, cap, s)
+    assert (pe == ref_e).all() and (pl == ref_l).all()

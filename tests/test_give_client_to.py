@@ -199,3 +199,35 @@ def test_handoff_parks_until_target_registers(cluster):
     assert c.wait_for(
         lambda c: ("pong", ("late",)) in c.player.calls, 10.0)
     c.close()
+
+
+def test_expired_handoff_kicks_stranded_client(cluster, monkeypatch):
+    """A parked handoff whose target never registers must not strand the
+    client forever: on park expiry the dispatcher kicks the client at its
+    gate (MT_KICK_CLIENT) so it can reconnect for a fresh boot entity."""
+    import goworld_tpu.components.dispatcher.service as dsvc
+
+    # shrink the park window so the test observes expiry quickly
+    monkeypatch.setattr(dsvc, "LOAD_BLOCK_TIMEOUT", 0.5)
+    disp, games, gate = cluster
+    c = GameClientConnection(gate.addr)
+    assert c.wait_for(lambda c: c.player is not None, 10.0)
+
+    from goworld_tpu.engine.ids import gen_id
+
+    c.call_player("do_handoff", gen_id())  # target will never exist
+    # the park expires and the dispatcher kicks the connection: the bot's
+    # poll sees EOF (recv returns no packets and the socket reports closed)
+    deadline = time.monotonic() + 10
+    closed = False
+    while time.monotonic() < deadline and not closed:
+        c.poll(0.05)
+        try:
+            if c.pc._sock.recv(1, __import__("socket").MSG_PEEK) == b"":
+                closed = True
+        except TimeoutError:
+            pass
+        except OSError:
+            closed = True
+    assert closed, "stranded client was never kicked after park expiry"
+    c.close()
