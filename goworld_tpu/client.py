@@ -42,7 +42,7 @@ class GameClientConnection:
 
     def __init__(self, addr: tuple[str, int], compression: str = "gwlz",
                  transport: str = "tcp", tls: bool = False,
-                 tls_cafile: str | None = None):
+                 tls_cafile: str | None = None, strict: bool = False):
         if transport == "kcp":
             if tls or tls_cafile:
                 raise ValueError("tls over kcp is not supported")
@@ -75,26 +75,43 @@ class GameClientConnection:
         self.filtered_calls: list[tuple] = []
         self._lock = threading.Lock()
         self.pc._sock.settimeout(0.01)
+        # strict protocol-invariant mode (reference: test_client -strict,
+        # ClientBot.go): hard violations raise; soft anomalies (explainable
+        # by in-flight races, e.g. a delta for a just-destroyed mirror) are
+        # counted in ``anomalies``
+        self.strict = strict
+        self.anomalies: dict[str, int] = {}
+        self.closed = False
+
+    def _violation(self, msg: str):
+        if self.strict:
+            raise AssertionError(f"protocol violation: {msg}")
+
+    def _anomaly(self, kind: str):
+        self.anomalies[kind] = self.anomalies.get(kind, 0) + 1
 
     # -- receive -----------------------------------------------------------
     def poll(self, duration: float = 0.0) -> int:
         """Process everything available (for up to ``duration`` seconds);
-        returns number of packets handled."""
+        returns number of packets handled.  Sets ``closed`` and returns
+        immediately on EOF (e.g. the server kicked this client)."""
         deadline = time.monotonic() + duration
         n = 0
-        while True:
+        while not self.closed:
             try:
                 pkt = self.pc.recv_packet()
             except TimeoutError:
-                pkt = None
-            except OSError:
-                break
-            if pkt is not None:
-                self._handle(pkt)
-                n += 1
+                if time.monotonic() >= deadline:
+                    break
                 continue
-            if time.monotonic() >= deadline:
+            except OSError:
+                self.closed = True
                 break
+            if pkt is None:  # recv_packet returns None only on clean EOF
+                self.closed = True
+                break
+            self._handle(pkt)
+            n += 1
         return n
 
     def wait_for(self, predicate, timeout: float = 5.0) -> bool:
@@ -108,6 +125,8 @@ class GameClientConnection:
     def _handle(self, pkt: Packet):
         msgtype = pkt.read_u16()
         if msgtype == MT.MT_CLIENT_HANDSHAKE:
+            if self.client_id is not None:
+                self._violation("second handshake")
             self.client_id = pkt.read_client_id()
         elif msgtype == MT.MT_CREATE_ENTITY_ON_CLIENT:
             type_name = pkt.read_varstr()
@@ -116,6 +135,17 @@ class GameClientConnection:
             attrs = pkt.read_data()
             pos = (pkt.read_f32(), pkt.read_f32(), pkt.read_f32())
             yaw = pkt.read_f32()
+            if eid in self.entities:
+                # a non-player duplicate means the server double-created a
+                # mirror; a player re-create happens on GiveClientTo handoff
+                if not is_player:
+                    self._violation(f"duplicate create for {eid}")
+                self._anomaly("recreate")
+            if is_player and self.player is not None and self.player.id != eid:
+                # ownership moved (handoff): the old player mirror must have
+                # been destroyed or will be -- track as anomaly if it wasn't
+                if self.player.id in self.entities:
+                    self._anomaly("player_switch_old_alive")
             e = ClientEntity(type_name, eid, is_player, attrs or {}, pos, yaw)
             self.entities[eid] = e
             if is_player:
@@ -123,6 +153,8 @@ class GameClientConnection:
         elif msgtype == MT.MT_DESTROY_ENTITY_ON_CLIENT:
             _type_name = pkt.read_varstr()
             eid = pkt.read_entity_id()
+            if eid not in self.entities:
+                self._violation(f"destroy for unknown mirror {eid}")
             e = self.entities.pop(eid, None)
             if e is not None and self.player is e:
                 self.player = None
@@ -132,6 +164,9 @@ class GameClientConnection:
             e = self.entities.get(eid)
             if e is not None:
                 apply_delta(e.attrs, tuple(d["p"]), d["o"], d["v"])
+            else:
+                # tolerated: the delta can race a destroy through the gate
+                self._anomaly("delta_unknown_mirror")
         elif msgtype == MT.MT_CALL_ENTITY_METHOD_ON_CLIENT:
             eid = pkt.read_entity_id()
             method = pkt.read_varstr()
@@ -139,6 +174,8 @@ class GameClientConnection:
             e = self.entities.get(eid)
             if e is not None:
                 e.calls.append((method, args))
+            else:
+                self._anomaly("call_unknown_mirror")
         elif msgtype == MT.MT_SYNC_POSITION_YAW_ON_CLIENTS:
             while pkt.remaining() > 0:
                 eid = pkt.read_entity_id()
@@ -148,10 +185,14 @@ class GameClientConnection:
                 if e is not None:
                     e.position = (x, y, z)
                     e.yaw = yaw
+                else:
+                    self._anomaly("sync_unknown_mirror")
         elif msgtype == MT.MT_CALL_FILTERED_CLIENTS:
             method = pkt.read_varstr()
             args = pkt.read_args()
             self.filtered_calls.append((method, args))
+        else:
+            self._violation(f"unexpected msgtype {msgtype}")
 
     # -- send --------------------------------------------------------------
     def call_server(self, eid: str, method: str, *args):

@@ -702,6 +702,14 @@ class GameService:
         for e in self.rt.entities.entities.values():
             gwutils.run_panicless(e.on_freeze, logger=self.log)
             d = e.migrate_data()
+            if e.interested_in:
+                # interest sets are part of the checkpoint: restore rebuilds
+                # them directly and seeds the AOI calculator's previous-tick
+                # state, so the first post-restore flush emits ONLY genuine
+                # diffs (changes that happened while frozen) -- no
+                # suppression heuristics (reference: quiet restore,
+                # EntityManager.go:591-652)
+                d["interests"] = [o.id for o in e.interested_in]
             if e.is_space:
                 d["kind"] = getattr(e, "kind", 0)
                 d["aoi_dist"] = getattr(e, "_aoi_default_dist", 0.0)
@@ -751,6 +759,7 @@ class GameService:
             for d in dump["spaces"]:
                 for mid, pos in d.get("members", ()):
                     member_pos[mid] = (d["id"], pos)
+            pending_interests = []
             for d in dump["entities"]:
                 e = self.rt.entities.restore(
                     d,
@@ -760,15 +769,48 @@ class GameService:
                 # quiet client reattach: no re-create on the client
                 if e.client is not None:
                     e.client.outbox.clear()
-                e.quiet_interest_ticks = 1  # client already has its neighbors
-                e._mark_dirty()  # the dirty-set sync phase runs the countdown
+                if d.get("interests"):
+                    pending_interests.append((e, d["interests"]))
                 where = member_pos.get(e.id)
                 if where is not None:
                     sp = id2space.get(where[0])
                     if sp is not None:
                         x, y, z = where[1]
-                        sp.enter_entity(e, Vector3(x, y, z))
+                        sp.enter_entity(e, Vector3(x, y, z),
+                                        is_restore=True)
                 gwutils.run_panicless(e.on_restored, logger=self.log)
+            # rebuild interest links quietly (no client ops, no hooks: the
+            # clients' mirrors ARE the frozen interest sets), then seed each
+            # space's AOI previous-tick words so the first flush diffs
+            # against the frozen state instead of replaying every pair
+            for e, ids in pending_interests:
+                for oid in ids:
+                    other = self.rt.entities.get(oid)
+                    if other is None:
+                        continue
+                    e.interested_in.add(other)
+                    other.interested_by.add(e)
+                    if e.client is not None:
+                        other._watcher_clients += 1
+            from ...ops import aoi_predicate as AP
+            import numpy as np
+
+            for sp in id2space.values():
+                h = sp._aoi_handle
+                if h is None:
+                    continue
+                cap = h.capacity
+                # build the packed words directly: O(pairs), not O(cap^2)
+                words = np.zeros((cap, AP.words_per_row(cap)), np.uint32)
+                for e in sp.entities:
+                    if e.aoi_slot < 0:
+                        continue
+                    for other in e.interested_in:
+                        if other.aoi_slot >= 0:
+                            w, b = AP.word_bit_for_column(
+                                other.aoi_slot, cap)
+                            words[e.aoi_slot, w] |= np.uint32(1) << np.uint32(b)
+                h.bucket.set_prev(h.slot, words)
             self.log.info("restored %d spaces + %d entities",
                           len(dump["spaces"]), len(dump["entities"]))
         finally:

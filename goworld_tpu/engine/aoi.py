@@ -349,9 +349,15 @@ class _TPUBucket(_Bucket):
         self._pending_reset: set[int] = set()
         self._pending_clear: list[tuple[int, int]] = []  # (slot, entity_slot)
         # adaptive extraction caps; a tick that exceeds them is recovered
-        # host-side from the full diff and the caps grow for the next tick
+        # host-side from the full diff and the caps grow for the next tick.
+        # A sliding peak window decays them again, so a one-off mass tick
+        # (space fill, restore storm) doesn't pessimize every later flush.
         self._max_chunks = 4096
         self._kcap = 8
+        self._peak_nd = 0
+        self._peak_mcc = 0
+        self._refit_at = 128  # flushes until the next decay check
+        self._flushes = 0
 
     def _grow_to(self, n_slots: int) -> None:
         jnp = self._jnp
@@ -439,6 +445,19 @@ class _TPUBucket(_Bucket):
         )
         vals, nv, lane, csel, ccnt, nd_d, mcc_d = ex
         nd, mcc = int(nd_d), int(mcc_d)
+        self._peak_nd = max(self._peak_nd, nd)
+        self._peak_mcc = max(self._peak_mcc, mcc)
+        self._flushes += 1
+        if self._flushes >= self._refit_at:
+            # decay toward the recent window's peaks (bounded below by the
+            # defaults) so caps track the steady state, not history's worst
+            fit_nd = max(4096, -(-self._peak_nd * 3 // 2 // 512) * 512)
+            fit_k = max(8, 1 << (self._peak_mcc * 2 - 1).bit_length())
+            if fit_nd < self._max_chunks or fit_k < self._kcap:
+                self._max_chunks = min(self._max_chunks, fit_nd)
+                self._kcap = min(self._kcap, fit_k)
+            self._peak_nd = self._peak_mcc = 0
+            self._flushes = 0
         if nd > mc or mcc > self._kcap:
             # caps exceeded: recover this tick from the full diff, then grow
             # the caps so the next tick extracts on device again

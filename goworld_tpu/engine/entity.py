@@ -119,11 +119,6 @@ class Entity:
         self._sync_flags = 0
         self._attr_deltas: list[tuple] = []  # (path, op, value) this tick
         self.destroyed = False
-        # >0: suppress client create/destroy during AOI interest replay -- set
-        # for the first tick after freeze-restore, when the client already has
-        # the neighbor entities (reference: isRestore quiet re-enter,
-        # EntityManager.go:591-652)
-        self.quiet_interest_ticks = 0
 
     # ------------------------------------------------------------------ api
     def _mark_dirty(self):
@@ -270,14 +265,13 @@ class Entity:
         # flush other's pending deltas to its *pre-existing* audience before
         # we join it: the snapshot below already contains them, and a mirror
         # that applied both would double-apply non-idempotent ops (APPEND/POP)
-        quiet = self.quiet_interest_ticks > 0
-        if self.client is not None and not quiet:
+        if self.client is not None:
             other._flush_attr_deltas()
         if other not in self.interested_in and self.client is not None:
             other._watcher_clients += 1
         self.interested_in.add(other)
         other.interested_by.add(self)
-        if self.client is not None and not quiet:
+        if self.client is not None:
             self.client.create_entity(other, is_player=False)
         self.on_enter_aoi(other)
 
@@ -286,7 +280,7 @@ class Entity:
             other._watcher_clients -= 1
         self.interested_in.discard(other)
         other.interested_by.discard(self)
-        if self.client is not None and self.quiet_interest_ticks == 0:
+        if self.client is not None:
             self.client.destroy_entity(other)
         self.on_leave_aoi(other)
 

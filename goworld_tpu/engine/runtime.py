@@ -98,10 +98,6 @@ class Runtime:
                 e._sync_flags = 0
             if e._attr_deltas:
                 e._flush_attr_deltas()
-            if e.quiet_interest_ticks:
-                e.quiet_interest_ticks -= 1
-                if e.quiet_interest_ticks:
-                    self._dirty_entities.add(e)
 
     def _collect_sync(self, e: Entity):
         """One 16-byte-payload record per flagged entity per tick

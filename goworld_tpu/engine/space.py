@@ -106,8 +106,13 @@ class Space(Entity):
             )
 
     # -- membership --------------------------------------------------------
-    def enter_entity(self, e: Entity, pos: Vector3):
-        """Reference: Space.enter, Space.go:188-226."""
+    def enter_entity(self, e: Entity, pos: Vector3, is_restore: bool = False):
+        """Reference: Space.enter, Space.go:188-226.  ``is_restore``
+        re-establishes membership after freeze-restore WITHOUT firing the
+        user enter hooks (reference: restore re-enters quietly,
+        EntityManager.go:591-652 -- a restore reconstructs state, it is not
+        a new enter; hooks like the demo's spawn-monsters-per-player must
+        not re-fire)."""
         if e.space is not None:
             raise ValueError(f"{e} already in a space")
         e.space = self
@@ -127,8 +132,9 @@ class Space(Entity):
             )
             self._act[slot] = True
             self._aoi_dirty = True
-        self.on_entity_enter_space(e)
-        e.on_enter_space()
+        if not is_restore:
+            self.on_entity_enter_space(e)
+            e.on_enter_space()
 
     def _next_slot(self) -> int:
         if self._slot_watermark >= self._cap:

@@ -152,3 +152,43 @@ def test_cli_build(rundir):
     r = cli(["build", "-s", str(bad)])
     assert r.returncode == 1
     assert "build FAILED" in r.stdout
+
+
+@pytest.mark.skipif(os.environ.get("GW_SOAK") != "1",
+                    reason="set GW_SOAK=1 for the 100-bot soak (reference "
+                           "CI scale: .travis.yml:36-46)")
+def test_soak_100_bots_reload_under_load(rundir):
+    """The reference's CI gauntlet: 100 strict bots for 30 s, a hot reload
+    UNDER load (freeze/restore with clients connected), then another 30 s
+    run -- all with the cross-bot AOI visibility oracle active."""
+    tmp_path, cfg, gate_port = rundir
+    run = str(tmp_path / "run")
+    script = os.path.join(REPO, "examples", "unity_demo", "server.py")
+    r = cli(["start", "-c", cfg, "-s", script, "-d", run])
+    assert r.returncode == 0, f"start failed:\n{r.stdout}\n{r.stderr}"
+
+    def bots(duration):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "examples", "test_client.py"),
+             "--gate", f"127.0.0.1:{gate_port}", "-N", "100",
+             "--duration", str(duration), "--strict"],
+            cwd=REPO, env=_env(), capture_output=True, text=True, timeout=300,
+        )
+
+    import threading
+
+    first = {}
+    t = threading.Thread(target=lambda: first.update(r=bots(30)))
+    t.start()
+    time.sleep(10)  # bots are mid-run: reload NOW (freeze/restore under load)
+    rr = cli(["reload", "-c", cfg, "-s", script, "-d", run], timeout=120)
+    t.join(300)
+    assert rr.returncode == 0, f"reload failed:\n{rr.stdout}\n{rr.stderr}"
+    out = first["r"]
+    assert out.returncode == 0, f"bots failed:\n{out.stdout}\n{out.stderr}"
+    assert "100/100 bots OK" in out.stdout
+    out2 = bots(30)
+    assert out2.returncode == 0, f"post-reload bots failed:\n{out2.stdout}\n{out2.stderr}"
+    assert "100/100 bots OK" in out2.stdout
+    r = cli(["stop", "-d", run])
+    assert r.returncode == 0
