@@ -51,6 +51,13 @@ class SharedTruth:
         with self.lock:
             self.pos[idx] = (eid, x, z)
 
+    def retract(self, idx):
+        """A finished/failed bot must leave the oracle's world: its entity
+        is (being) destroyed server-side, so judging against its last
+        position would hard-fail every nearby surviving bot."""
+        with self.lock:
+            self.pos.pop(idx, None)
+
     def snapshot(self):
         with self.lock:
             return dict(self.pos)
@@ -61,11 +68,16 @@ class Stats:
         self.lock = threading.Lock()
         self.samples: dict[str, list[float]] = {}
         self.window: dict[str, list[float]] = {}
+        self.counters: dict[str, int] = {}
 
     def record(self, op, dt):
         with self.lock:
             self.samples.setdefault(op, []).append(dt)
             self.window.setdefault(op, []).append(dt)
+
+    def count(self, name, n):
+        with self.lock:
+            self.counters[name] = self.counters.get(name, 0) + n
 
     def dump_window(self):
         with self.lock:
@@ -85,6 +97,8 @@ class Stats:
                    if len(ms) > 20 else max(ms))
             print(f"{op:8s} n={len(ms):<7d} avg={statistics.mean(ms):8.2f}ms "
                   f"p95={p95:8.2f}ms max={max(ms):8.2f}ms")
+        for name, n in sorted(self.counters.items()):
+            print(f"{name}: {n}")
 
 
 class Bot(threading.Thread):
@@ -113,6 +127,8 @@ class Bot(threading.Thread):
             self.error = f"{type(e).__name__}: {e}"
             if self.strict:
                 raise
+        finally:
+            self.truth.retract(self.idx)
 
     def _assert(self, cond, msg):
         if self.strict:
@@ -212,7 +228,7 @@ class Bot(threading.Thread):
                     self._check_visibility(c, x, z, now)
                     last_vis = now
         for kind, n in c.anomalies.items():
-            self.stats.record(f"anomaly.{kind}", n / 1e3)
+            self.stats.count(f"anomaly.{kind}", n)
         c.close()
 
 

@@ -1,0 +1,161 @@
+"""In-process pymongo-compatible fake (the miniredis pattern, for Mongo).
+
+Implements exactly the client surface the mongodb storage/kvdb backends and
+gwdoc's PymongoEngine use -- ``client[db][coll]`` with ``insert_one``
+(duplicate _id raises), ``replace_one(upsert=)``, ``find_one``, ``find``
+(+``sort``/projection), ``count_documents``, ``delete_one``/``delete_many``.
+Backends accept an injected client, so their logic runs under test in this
+image (no mongod, no pymongo); against a real deployment the same code gets
+a real ``pymongo.MongoClient``.
+
+Reference role: the reference tests its mongodb backends against a live
+mongod in CI (/root/reference/engine/storage/storage_test.go pattern); this
+fake is the hermetic stand-in.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class DuplicateKeyError(Exception):
+    pass
+
+
+def _match(doc: dict, flt: dict) -> bool:
+    for k, cond in flt.items():
+        v = doc.get(k)
+        if isinstance(cond, dict):
+            for op, rhs in cond.items():
+                if op == "$gte":
+                    if not (v is not None and v >= rhs):
+                        return False
+                elif op == "$gt":
+                    if not (v is not None and v > rhs):
+                        return False
+                elif op == "$lte":
+                    if not (v is not None and v <= rhs):
+                        return False
+                elif op == "$lt":
+                    if not (v is not None and v < rhs):
+                        return False
+                elif op == "$ne":
+                    if v == rhs:
+                        return False
+                elif op == "$eq":
+                    if v != rhs:
+                        return False
+                else:
+                    raise ValueError(f"minimongo: unsupported operator {op}")
+        elif v != cond:
+            return False
+    return True
+
+
+class _Cursor:
+    def __init__(self, docs: list[dict], projection: dict | None):
+        self._docs = docs
+        self._proj = projection
+
+    def sort(self, key: str, direction: int = 1) -> "_Cursor":
+        self._docs.sort(key=lambda d: d.get(key), reverse=direction < 0)
+        return self
+
+    def limit(self, n: int) -> "_Cursor":
+        self._docs = self._docs[:n]
+        return self
+
+    def _project(self, d: dict) -> dict:
+        if not self._proj:
+            return dict(d)
+        keep = {k for k, v in self._proj.items() if v}
+        if "_id" not in self._proj:
+            keep.add("_id")  # mongo includes _id unless excluded
+        return {k: v for k, v in d.items() if k in keep}
+
+    def __iter__(self):
+        return (self._project(d) for d in self._docs)
+
+
+class MiniCollection:
+    def __init__(self):
+        self._docs: dict[Any, dict] = {}
+        self._lock = threading.Lock()
+
+    def insert_one(self, doc: dict):
+        with self._lock:
+            _id = doc.get("_id")
+            if _id in self._docs:
+                raise DuplicateKeyError(f"duplicate _id {_id!r}")
+            self._docs[_id] = dict(doc)
+
+    def replace_one(self, flt: dict, doc: dict, upsert: bool = False):
+        with self._lock:
+            for _id, d in self._docs.items():
+                if _match(d, flt):
+                    self._docs[_id] = dict(doc)
+                    return
+            if upsert:
+                self._docs[doc.get("_id")] = dict(doc)
+
+    def find_one(self, flt: dict | None = None) -> dict | None:
+        with self._lock:
+            for d in self._docs.values():
+                if flt is None or _match(d, flt):
+                    return dict(d)
+        return None
+
+    def find(self, flt: dict | None = None,
+             projection: dict | None = None) -> _Cursor:
+        with self._lock:
+            docs = [dict(d) for d in self._docs.values()
+                    if flt is None or _match(d, flt)]
+        return _Cursor(docs, projection)
+
+    def count_documents(self, flt: dict | None = None,
+                        limit: int | None = None) -> int:
+        with self._lock:
+            n = sum(1 for d in self._docs.values()
+                    if flt is None or _match(d, flt))
+        return min(n, limit) if limit else n
+
+    def delete_one(self, flt: dict):
+        with self._lock:
+            for _id, d in list(self._docs.items()):
+                if _match(d, flt):
+                    del self._docs[_id]
+                    return
+
+    def delete_many(self, flt: dict):
+        with self._lock:
+            for _id, d in list(self._docs.items()):
+                if _match(d, flt):
+                    del self._docs[_id]
+
+
+class MiniDB:
+    def __init__(self):
+        self._cols: dict[str, MiniCollection] = {}
+        self._lock = threading.Lock()
+
+    def __getitem__(self, name: str) -> MiniCollection:
+        with self._lock:
+            if name not in self._cols:
+                self._cols[name] = MiniCollection()
+            return self._cols[name]
+
+
+class MiniMongoClient:
+    def __init__(self):
+        self._dbs: dict[str, MiniDB] = {}
+        self._lock = threading.Lock()
+
+    def __getitem__(self, name: str) -> MiniDB:
+        with self._lock:
+            if name not in self._dbs:
+                self._dbs[name] = MiniDB()
+            return self._dbs[name]
+
+    def close(self):
+        pass

@@ -255,16 +255,19 @@ class MongoKVDB(KVDBBackend):
     config_kind = "server"
 
     def __init__(self, host: str = "127.0.0.1", port: int = 27017,
-                 db: int | str = "goworld"):
-        try:
-            import pymongo
-        except ImportError as e:
-            raise RuntimeError(
-                "the mongodb kvdb backend requires the pymongo driver"
-            ) from e
+                 db: int | str = "goworld", client=None):
         from ..ext.db.dbutil import db_name
 
-        self._client = pymongo.MongoClient(host, port)
+        if client is None:
+            try:
+                import pymongo
+            except ImportError as e:
+                raise RuntimeError(
+                    "the mongodb kvdb backend requires the pymongo driver"
+                ) from e
+            client = pymongo.MongoClient(host, port)
+        # pymongo-compatible client; tests inject minimongo (see storage)
+        self._client = client
         self._col = self._client[db_name(db)]["kvdb"]
 
     def get(self, key: str) -> str | None:
@@ -293,10 +296,12 @@ class MySQLKVDB(KVDBBackend):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 3306,
                  db: int | str = "goworld", user: str = "root",
-                 password: str = ""):
+                 password: str = "", conn=None):
         from ..ext.db.dbutil import connect_mysql, db_name
 
-        self._db = connect_mysql(host, port, user, password, db_name(db))
+        # DB-API connection with the %s paramstyle (tests inject a shim)
+        self._db = conn if conn is not None else connect_mysql(
+            host, port, user, password, db_name(db))
         cur = self._db.cursor()
         cur.execute(
             "CREATE TABLE IF NOT EXISTS kv"

@@ -214,18 +214,22 @@ class MongoEntityStorage(EntityStorageBackend):
     config_kind = "server"
 
     def __init__(self, host: str = "127.0.0.1", port: int = 27017,
-                 db: int | str = "goworld"):
-        try:
-            import pymongo
-        except ImportError as e:
-            raise RuntimeError(
-                "the mongodb storage backend requires the pymongo driver"
-            ) from e
+                 db: int | str = "goworld", client=None):
         from ..ext.db.dbutil import db_name
 
-        self._client = pymongo.MongoClient(host, port)
-        name = db_name(db)
-        self._db = self._client[name]
+        if client is None:
+            try:
+                import pymongo
+            except ImportError as e:
+                raise RuntimeError(
+                    "the mongodb storage backend requires the pymongo driver"
+                ) from e
+            client = pymongo.MongoClient(host, port)
+        # ``client`` is any pymongo-compatible client -- a real MongoClient
+        # or ext/db/minimongo.MiniMongoClient (how the hermetic tests run
+        # this backend's logic in a driverless image)
+        self._client = client
+        self._db = self._client[db_name(db)]
 
     def write(self, type_name: str, eid: str, data: dict) -> None:
         self._db[type_name].replace_one(
@@ -257,10 +261,13 @@ class MySQLEntityStorage(EntityStorageBackend):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 3306,
                  db: int | str = "goworld", user: str = "root",
-                 password: str = ""):
+                 password: str = "", conn=None):
         from ..ext.db.dbutil import connect_mysql, db_name
 
-        self._db = connect_mysql(host, port, user, password, db_name(db))
+        # ``conn`` is any DB-API connection speaking the %s paramstyle -- a
+        # real MySQL driver connection, or the tests' sqlite shim
+        self._db = conn if conn is not None else connect_mysql(
+            host, port, user, password, db_name(db))
         cur = self._db.cursor()
         cur.execute(
             "CREATE TABLE IF NOT EXISTS entities ("

@@ -184,11 +184,21 @@ def test_soak_100_bots_reload_under_load(rundir):
     rr = cli(["reload", "-c", cfg, "-s", script, "-d", run], timeout=120)
     t.join(300)
     assert rr.returncode == 0, f"reload failed:\n{rr.stdout}\n{rr.stderr}"
+    import re
+
+    def vis_checks(stdout):
+        m = re.search(r"visibility checks: (\d+)", stdout)
+        return int(m.group(1)) if m else 0
+
     out = first["r"]
     assert out.returncode == 0, f"bots failed:\n{out.stdout}\n{out.stderr}"
     assert "100/100 bots OK" in out.stdout
+    assert vis_checks(out.stdout) > 0, \
+        "visibility oracle never asserted anything:\n" + out.stdout
     out2 = bots(30)
     assert out2.returncode == 0, f"post-reload bots failed:\n{out2.stdout}\n{out2.stderr}"
     assert "100/100 bots OK" in out2.stdout
+    assert vis_checks(out2.stdout) > 0, \
+        "visibility oracle never asserted anything:\n" + out2.stdout
     r = cli(["stop", "-d", run])
     assert r.returncode == 0

@@ -300,3 +300,74 @@ def test_gated_backend_error_message():
         pass
     with pytest.raises(RuntimeError, match="pymongo"):
         new_entity_storage("mongodb")
+
+
+# -- mongodb / mysql backends through injected fakes -------------------------
+# The reference CI runs these against live mongod/mysqld services
+# (.travis.yml); this image has neither the servers nor the drivers, so the
+# backends' own logic is exercised through pymongo-compatible /
+# DB-API-compatible stand-ins (the miniredis pattern).
+
+class _SqliteAsMySQL:
+    """DB-API shim: a sqlite3 connection that accepts the %s paramstyle and
+    the (tiny) MySQL dialect subset the backends emit."""
+
+    def __init__(self):
+        import sqlite3
+
+        self._conn = sqlite3.connect(":memory:", check_same_thread=False)
+
+    class _Cur:
+        def __init__(self, cur):
+            self._cur = cur
+
+        def execute(self, sql, params=()):
+            return self._cur.execute(sql.replace("%s", "?"), params)
+
+        def fetchone(self):
+            return self._cur.fetchone()
+
+        def fetchall(self):
+            return self._cur.fetchall()
+
+    def cursor(self):
+        return self._Cur(self._conn.cursor())
+
+    def close(self):
+        self._conn.close()
+
+
+def test_mongodb_entity_storage_minimongo():
+    from goworld_tpu.ext.db.minimongo import MiniMongoClient
+    from goworld_tpu.storage.backends import MongoEntityStorage
+
+    _exercise_entity_storage(MongoEntityStorage(client=MiniMongoClient()))
+
+
+def test_mongodb_kvdb_minimongo():
+    from goworld_tpu.ext.db.minimongo import MiniMongoClient
+    from goworld_tpu.kvdb.backends import MongoKVDB
+
+    _exercise_kvdb(MongoKVDB(client=MiniMongoClient()))
+
+
+def test_mysql_entity_storage_dbapi_shim():
+    from goworld_tpu.storage.backends import MySQLEntityStorage
+
+    _exercise_entity_storage(MySQLEntityStorage(conn=_SqliteAsMySQL()))
+
+
+def test_mysql_kvdb_dbapi_shim():
+    from goworld_tpu.kvdb.backends import MySQLKVDB
+
+    _exercise_kvdb(MySQLKVDB(conn=_SqliteAsMySQL()))
+
+
+def test_minimongo_duplicate_id_raises():
+    from goworld_tpu.ext.db.minimongo import (DuplicateKeyError,
+                                              MiniMongoClient)
+
+    col = MiniMongoClient()["db"]["c"]
+    col.insert_one({"_id": "a", "v": 1})
+    with pytest.raises(DuplicateKeyError):
+        col.insert_one({"_id": "a", "v": 2})
