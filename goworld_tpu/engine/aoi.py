@@ -74,22 +74,30 @@ def _batched_clear(prev_all, row_slots, row_ents, col_slots, col_words,
                        col_masks)
 
 
+_LANES = 128
+_MAX_GAPS = 2048    # escaped chunk-index deltas per flush
+_MAX_EXC = 32768    # exception triples (tail + multi-bit words) per flush
+
+
 def _fused_bucket_step(prev_all, slot_idx, x, z, r, act, max_chunks, kcap):
     """One device program per bucket flush: gather staged slots' previous
-    words, run the fused AOI kernel, scatter the new words back, and compact
-    the diff with the chunk extraction (ops/events.py extract_chunks -- no
+    words, run the fused AOI kernel, scatter the new words back, compact the
+    diff with the chunk extraction (ops/events.py extract_chunks -- no
     per-element gathers; the NEW words ride the same chunk gather so
-    enter/leave classification is free).  A single dispatch instead of six
-    (dispatch latency is per tick on the production path).
+    enter/leave classification is free), and wire-encode the result
+    (~5 B/dirty chunk + 12 B/exception) so the host fetch is the encoded
+    stream, not raw grids.  A single dispatch instead of six (dispatch
+    latency is per tick on the production path).
 
-    Also returns ``chg``/``new`` so a cap-overflow tick can be recovered
-    host-side -- ``prev_all`` is donated, so the diff would otherwise be
-    unrecoverable."""
+    Also returns ``chg``/``new`` and the raw grids so cap-overflow ticks can
+    be recovered host-side -- ``prev_all`` is donated, so the diff would
+    otherwise be unrecoverable."""
     global _fused_impl
     if _fused_impl is None:
         import functools
 
         import jax
+        import jax.numpy as jnp
 
         from ..ops.aoi_pallas import aoi_step_pallas
 
@@ -99,8 +107,16 @@ def _fused_bucket_step(prev_all, slot_idx, x, z, r, act, max_chunks, kcap):
             prev_rows = prev_all[slot_idx]
             new, chg = aoi_step_pallas(x, z, r, act, prev_rows, emit="chg")
             prev_all = prev_all.at[slot_idx].set(new)
-            ex = EV.extract_chunks(chg, max_chunks, kcap, aux=new, lanes=128)
-            return prev_all, new, chg, ex
+            ex = EV.extract_chunks(chg, max_chunks, kcap, aux=new,
+                                   lanes=_LANES)
+            vals, nv, lane, csel, ccnt, nd, mcc = ex
+            enc = EV.encode_row_stream(vals, nv, lane, csel, ccnt,
+                                       w=_LANES, max_gaps=_MAX_GAPS,
+                                       max_exc=_MAX_EXC)
+            (rowb, bitpos, woff, base_row, n_esc, esc_rows,
+             exc_gidx, exc_chg, exc_new, exc_n) = enc
+            scalars = jnp.stack([nd, mcc, base_row, n_esc, exc_n])
+            return prev_all, new, chg, ex, enc, scalars
 
         _fused_impl = impl
     return _fused_impl(prev_all, slot_idx, x, z, r, act, max_chunks, kcap)
@@ -437,14 +453,16 @@ class _TPUBucket(_Bucket):
         self._staged.clear()
 
         slot_idx = jnp.asarray(slots, jnp.int32)
-        n_chunks_total = s_n * c * self.W // 128
+        n_chunks_total = s_n * c * self.W // _LANES
         mc = min(self._max_chunks, max(n_chunks_total, 512))
-        self.prev, new, chg, ex = _fused_bucket_step(
+        self.prev, new, chg, ex, enc, scalars = _fused_bucket_step(
             self.prev, slot_idx, jnp.asarray(x), jnp.asarray(z),
             jnp.asarray(r), jnp.asarray(act), mc, self._kcap
         )
-        vals, nv, lane, csel, ccnt, nd_d, mcc_d = ex
-        nd, mcc = int(nd_d), int(mcc_d)
+        # ONE tiny fetch for all control scalars (each synchronous fetch
+        # pays a round trip when the chip is reached over a network tunnel)
+        nd, mcc, base_row, n_esc, exc_n = (int(v) for v in
+                                           np.asarray(scalars))
         self._peak_nd = max(self._peak_nd, nd)
         self._peak_mcc = max(self._peak_mcc, mcc)
         self._flushes += 1
@@ -468,10 +486,10 @@ class _TPUBucket(_Bucket):
             gidx = np.nonzero(chg_h)[0]
             chg_vals = chg_h[gidx]
             ent_vals = chg_vals & new_h[gidx]
-        else:
-            # fetch only the dirty prefix (padded to a stable shape), with
-            # the four transfers overlapped -- each synchronous fetch pays a
-            # round trip when the chip is reached over a network tunnel
+        elif n_esc > _MAX_GAPS or exc_n > _MAX_EXC:
+            # encode overflow (pathological churn): rebuild from the raw
+            # grids kept on device
+            vals, nv, lane, csel = ex[0], ex[1], ex[2], ex[3]
             ndp = min(mc, -(-max(nd, 1) // 512) * 512)
             slices = (vals[:ndp], nv[:ndp], lane[:ndp], csel[:ndp])
             for a in slices:
@@ -480,7 +498,24 @@ class _TPUBucket(_Bucket):
             valid = lh >= 0
             chg_vals = vh[valid]
             ent_vals = chg_vals & nh[valid]
-            gidx = (ch[:, None].astype(np.int64) * 128 + lh)[valid]
+            gidx = (ch[:, None].astype(np.int64) * _LANES + lh)[valid]
+        else:
+            # the common path fetches the ENCODED stream: ~5 B per dirty
+            # chunk + 12 B per exception, overlapped slice transfers
+            (rowb, bitpos, woff, _b, _ne, esc_rows,
+             exc_gidx, exc_chg, exc_new, _xn) = enc
+            ndp = min(mc, -(-max(nd, 1) // 128) * 128)
+            escp = min(_MAX_GAPS, -(-max(n_esc, 1) // 64) * 64)
+            excp = min(_MAX_EXC, -(-max(exc_n, 1) // 256) * 256)
+            slices = (rowb[:ndp], bitpos[:ndp], woff[:ndp],
+                      esc_rows[:escp], exc_gidx[:excp], exc_chg[:excp],
+                      exc_new[:excp])
+            for a in slices:
+                a.copy_to_host_async()
+            hb = [np.asarray(a) for a in slices]
+            chg_vals, ent_vals, gidx = EV.decode_row_stream(
+                hb[0], hb[1], hb[2].astype(np.uint16), base_row, nd,
+                _LANES, hb[3], hb[4], hb[5], hb[6])
         pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx, c, s_n)
         ent_rows = self._split_rows(pe)
         lv_rows = self._split_rows(pl)

@@ -1,12 +1,15 @@
 """In-process pymongo-compatible fake (the miniredis pattern, for Mongo).
 
-Implements exactly the client surface the mongodb storage/kvdb backends and
-gwdoc's PymongoEngine use -- ``client[db][coll]`` with ``insert_one``
-(duplicate _id raises), ``replace_one(upsert=)``, ``find_one``, ``find``
-(+``sort``/projection), ``count_documents``, ``delete_one``/``delete_many``.
-Backends accept an injected client, so their logic runs under test in this
-image (no mongod, no pymongo); against a real deployment the same code gets
-a real ``pymongo.MongoClient``.
+Implements exactly the client surface the mongodb STORAGE and KVDB
+backends use -- ``client[db][coll]`` with ``insert_one`` (duplicate _id
+raises), ``replace_one(upsert=)``, ``find_one``, ``find``
+(+``sort``/projection/limit), ``count_documents``,
+``delete_one``/``delete_many``.  (NOT a full pymongo fake: gwdoc's
+PymongoEngine needs result objects, update_one/update_many and index
+management -- run that against a real pymongo.)  Backends accept an
+injected client, so their logic runs under test in this image (no mongod,
+no pymongo); against a real deployment the same code gets a real
+``pymongo.MongoClient``.
 
 Reference role: the reference tests its mongodb backends against a live
 mongod in CI (/root/reference/engine/storage/storage_test.go pattern); this
@@ -59,7 +62,11 @@ class _Cursor:
         self._proj = projection
 
     def sort(self, key: str, direction: int = 1) -> "_Cursor":
-        self._docs.sort(key=lambda d: d.get(key), reverse=direction < 0)
+        # pymongo orders documents missing the sort key first (BSON null
+        # sorts lowest); mirror that instead of crashing on None < value
+        self._docs.sort(
+            key=lambda d: (d.get(key) is not None, d.get(key)),
+            reverse=direction < 0)
         return self
 
     def limit(self, n: int) -> "_Cursor":

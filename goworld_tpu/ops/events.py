@@ -48,104 +48,6 @@ def extract_pairs(words, capacity: int, max_events: int):
     return jnp.stack([i, j], axis=1).astype(jnp.int32), count
 
 
-_GROUP = 16               # words per summary group of the two-level top_k
-_SEARCH_MIN_N = 1 << 19   # above this, cumsum+searchsorted wins over top_k
-
-
-def _nonzero_words_topk(flat, max_words: int):
-    """Two-level top_k compaction (fast for segments up to ~512K words).
-
-    (1) top_k over N/16 group-any summaries finds the groups holding
-    nonzero words, (2) top_k over the gathered 16-word candidate windows
-    compacts the words themselves.  Measured ~5 ms/tick at N=16.7M/64 segs
-    on v5e.  Group-any uses strided ORs and the window fetch a flat 1-D
-    gather: a reshape to [ng, 16] would pad the minor dim to 128 in TPU
-    tiling (8x memory).  top_k's descending order on the score ``N - i``
-    yields ascending indices, matching jnp.nonzero's order.
-    """
-    n = flat.shape[0]
-    nz_count = jnp.sum((flat != 0).astype(jnp.int32))
-    group = _GROUP
-    while n % group:  # tiny arrays: fall back to group=1 (pure top_k)
-        group //= 2
-    ng = n // group
-    mg = min(max_words, ng)  # every nonzero word may sit in its own group
-    g_acc = flat[0::group]
-    for k in range(1, group):
-        g_acc = g_acc | flat[k::group]
-    g_any = g_acc != 0
-    gscore = jnp.where(g_any, ng - jnp.arange(ng, dtype=jnp.int32), 0)
-    gv, gidx = jax.lax.top_k(gscore, mg)
-    gsel = jnp.where(gv > 0, gidx, 0)
-    cidx = (gsel[:, None] * group
-            + jnp.arange(group, dtype=jnp.int32)[None, :]).reshape(-1)
-    cand = flat[cidx].reshape(mg, group)
-    cand = jnp.where((gv > 0)[:, None], cand, jnp.uint32(0)).reshape(-1)
-    m = mg * group
-    k = min(max_words, m)
-    cscore = jnp.where(cand != 0, m - jnp.arange(m, dtype=jnp.int32), 0)
-    cv, cidx = jax.lax.top_k(cscore, k)
-    sel = jnp.where(cv > 0, cidx, 0)
-    vals = jnp.where(cv > 0, cand[sel], jnp.uint32(0))
-    wi = jnp.where(cv > 0, gsel[sel // group] * group + sel % group, -1)
-    if k < max_words:
-        pad = max_words - k
-        vals = jnp.concatenate([vals, jnp.zeros(pad, jnp.uint32)])
-        wi = jnp.concatenate([wi, jnp.full(pad, -1, wi.dtype)])
-    return vals, wi.astype(jnp.int32), nz_count
-
-
-def _nonzero_words_search(flat, max_words: int):
-    """Cumsum + binary-search compaction (giant segments).
-
-    Extraction is a *filter-compaction*: the index of the t-th nonzero word
-    is the first position where the inclusive cumsum of the nonzero mask
-    reaches t -- one cumsum pass (~23 ms for 537M words on v5e) plus a
-    vectorized binary search per output slot.  Lookup cost is
-    slots x log2(N) random gathers (~70M gathered elements/s), which beats
-    batched top_k once segments outgrow ~512K words (top_k measured ~900 ms
-    at 537M words; this path ~200 ms).
-    """
-    n = flat.shape[0]
-    csum = jnp.cumsum((flat != 0).astype(jnp.int32))
-    nz_count = csum[-1]
-    k = min(max_words, n)
-    targets = jnp.arange(1, k + 1, dtype=jnp.int32)
-    wi = jnp.searchsorted(csum, targets, side="left").astype(jnp.int32)
-    valid = targets <= nz_count
-    vals = jnp.where(valid, flat[jnp.where(valid, wi, 0)], 0)
-    wi = jnp.where(valid, wi, -1)
-    if k < max_words:
-        pad = max_words - k
-        vals = jnp.concatenate([vals, jnp.zeros(pad, jnp.uint32)])
-        wi = jnp.concatenate([wi, jnp.full(pad, -1, wi.dtype)])
-    return vals, wi, nz_count
-
-
-@functools.partial(jax.jit, static_argnames=("max_words",))
-def _nonzero_words_impl(flat, max_words: int):
-    if flat.shape[0] > _SEARCH_MIN_N:
-        return _nonzero_words_search(flat, max_words)
-    return _nonzero_words_topk(flat, max_words)
-
-
-def extract_nonzero_words(words, max_words: int):
-    """Scalable two-stage extraction for batched spaces.
-
-    ``words`` is [S, C, W] (a whole capacity bucket).  Device side finds up to
-    ``max_words`` nonzero uint32 words and their flat indices; the host
-    expands the <=32 set bits of each word with numpy (cheap) instead of
-    unpacking the full [S, C, C] boolean tensor on device.  D2H volume is
-    O(max_words), not O(S*C^2).
-
-    Returns (vals [max_words] uint32, flat_idx [max_words] int32,
-    nonzero_word_count) -- if nonzero_word_count > max_words the caller must
-    fall back to downloading ``words`` and extracting host-side.
-    """
-    s, c, w = words.shape
-    return _nonzero_words_impl(words.reshape(-1), max_words)
-
-
 def extract_chunks(words, max_chunks: int, k: int, aux=None,
                    lanes: int = 128):
     """Chunk-compacted extraction over 128-lane windows (the fast path).

@@ -80,7 +80,8 @@ class SpaceMesh:
 
 
 def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
-                          block_rows: int = 128, max_words: int = 0):
+                          block_rows: int = 128, max_words: int = 0,
+                          chunk_k: int = 8):
     """Build the multi-chip AOI tick: [S, C] arrays sharded over chips.
 
     S must be a multiple of the mesh size.  Returns a jitted function
@@ -88,13 +89,21 @@ def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
     where total_events is a scalar psum over the mesh (the only collective).
 
     With ``max_words > 0`` each chip also compacts its own diff words
-    (ops/events two-level extraction, chip-local -- event delivery needs no
-    collectives either) and the function returns
-    ``(new, (ent_vals, ent_idx, ent_n), (lv_vals, lv_idx, lv_n), total)``
-    with the per-chip event arrays stacked on the leading axis
-    ([n_dev * max_words] sharded; reshape to [n_dev, max_words]).  Word
-    indices are LOCAL to the chip's space block: global space index =
-    chip * S_local + local_space.
+    chip-locally via the chunk extraction (ops/events.extract_chunks, the
+    same gather-free path the single-chip production bucket runs) -- event
+    delivery needs no collectives either.  The function then returns
+    ``(new, ent_stream, lv_stream, total)`` where each stream is
+    ``(vals, idx, n, n_dirty, max_ccnt)`` with per-chip arrays stacked on
+    the leading axis: vals/idx are ``[n_dev * max_chunks, chunk_k]``
+    sharded (reshape to ``[n_dev, max_chunks, chunk_k]``; idx -1 = empty
+    slot), ``n`` the per-chip count of nonzero WORDS extracted, and
+    ``n_dirty``/``max_ccnt`` the EXACT per-chip dirty-chunk count and
+    words-per-chunk peak -- ``n_dirty > max_chunks`` or ``max_ccnt >
+    chunk_k`` means that chip's stream is incomplete and the caller must
+    fall back (the same overflow contract as ops/events.extract_chunks).
+    ``max_chunks`` is ``max_words`` rounded down to whole 128-lane chunks
+    (minimum 1).  Word indices are LOCAL to the chip's space block: global
+    space index = chip * S_local + local_space.
     """
     mesh = space_mesh.mesh
     axis = space_mesh.axis
@@ -125,18 +134,26 @@ def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
 
         out_specs = (spec, spec, spec, PS())
     else:
-        from ..ops.events import extract_nonzero_words
+        from ..ops.events import extract_chunks
+
+        max_chunks = max(1, max_words // 128)
+
+        def _extract(words):
+            vals, _aux, lane, csel, ccnt, nd, mcc = extract_chunks(
+                words, max_chunks, chunk_k, lanes=128)
+            gidx = jnp.where(lane >= 0,
+                             csel[:, None] * 128 + jnp.maximum(lane, 0), -1)
+            n_words = jnp.sum(jnp.minimum(ccnt, chunk_k), dtype=jnp.int32)
+            # scalars become [1] so they stack into [n_dev] across the mesh
+            return (vals, gidx, n_words.reshape(1), nd.reshape(1),
+                    mcc.reshape(1))
 
         def _local(x, z, r, act, prev):
             new, ent, lv = _kernel(x, z, r, act, prev)
-            ev, ei, en = extract_nonzero_words(ent, max_words)
-            lv_v, li, ln = extract_nonzero_words(lv, max_words)
-            # counts become [1] so they stack into [n_dev] across the mesh
-            ee = (ev, ei, en.reshape(1))
-            le = (lv_v, li, ln.reshape(1))
-            return new, ee, le, _total(ent, lv)
+            return new, _extract(ent), _extract(lv), _total(ent, lv)
 
-        ev_spec = (spec, spec, spec)  # vals, idx, count stack per chip
+        # vals, idx, n_words, n_dirty, max_ccnt stack per chip
+        ev_spec = (spec, spec, spec, spec, spec)
         out_specs = (spec, ev_spec, ev_spec, PS())
 
     step = jax.shard_map(

@@ -150,3 +150,25 @@ def test_facade_crontab(cluster):
     clock[0] += 60
     time.sleep(0.2)
     assert len(fired) == 2, "entry fired after unregister"
+
+
+def test_cn_facade_parallel_surface(cluster):
+    """goworld_cn is a genuine parallel API surface (reference:
+    cn/goworld_cn.go) -- every Chinese-named function delegates to its
+    English twin, and the whole English surface is re-exported."""
+    from goworld_tpu import goworld_cn as cn
+
+    disp, (g1, g2) = cluster
+    # re-export: the English surface is present
+    for name in ("run", "register_entity", "call", "kvdb_get", "post",
+                 "register_crontab", "Entity"):
+        assert hasattr(cn, name), name
+    # delegation: Chinese-named wrappers hit the same bound game
+    assert on_logic(g1, lambda: cn.获取GameID()) == g1.id
+    eid = on_logic(g1, lambda: cn.本地创建实体("Pawn").id)
+    assert on_logic(g1, lambda: cn.获取实体(eid)) is not None
+    got = []
+    on_logic(g1, lambda: cn.KV写("cnk", "v1", lambda _: got.append("put")))
+    assert _wait(lambda: "put" in got)
+    on_logic(g1, lambda: cn.KV读("cnk", lambda v: got.append(v)))
+    assert _wait(lambda: "v1" in got)

@@ -75,13 +75,21 @@ def test_sharded_step_with_chip_local_extraction():
     prev = np.zeros((S, cap, w), np.uint32)
 
     sm = SpaceMesh(devices)
-    step = make_sharded_aoi_step(sm, use_pallas=True, max_words=MW)
-    new, (ev, ei, en), (lvv, li, ln), total = step(
+    # chunk_k=128 makes per-chunk extraction always complete (a 128-lane
+    # chunk cannot hold more than 128 nonzero words)
+    step = make_sharded_aoi_step(sm, use_pallas=True, max_words=MW,
+                                 chunk_k=128)
+    new, (ev, ei, en, nd, mcc), (lvv, li, ln, lnd, lmcc), total = step(
         sm.device_put(x), sm.device_put(z), sm.device_put(r),
         sm.device_put(act), sm.device_put(prev),
     )
-    ev = np.asarray(ev).reshape(n_dev, MW)
-    ei = np.asarray(ei).reshape(n_dev, MW)
+    # overflow contract: the exact scalars prove the streams are complete
+    assert (np.asarray(nd) <= MW // 128).all()
+    assert (np.asarray(mcc) <= 128).all()
+    mc = MW // 128
+    ev = np.asarray(ev).reshape(n_dev, -1)
+    ei = np.asarray(ei).reshape(n_dev, -1)
+    assert ev.shape[1] == mc * 128
     en = np.asarray(en)
     assert en.shape == (n_dev,)
 
