@@ -499,18 +499,27 @@ def bench_engine(cfg, backend=None):
 
     n = len(ents)
     ticks = cfg.ticks
-    wx = rng.uniform(-STEP, STEP, (ticks, n)).astype(np.float32)
-    wz = rng.uniform(-STEP, STEP, (ticks, n)).astype(np.float32)
+    # warmup ticks (untimed, TPU only): the prime's mass-enter grows the
+    # TPU bucket's adaptive extraction caps, and the first post-growth
+    # flush recompiles; steady state is what the measurement is for
+    warmup = 3 if backend == "tpu" else 0
+    wx = rng.uniform(-STEP, STEP, (ticks + warmup, n)).astype(np.float32)
+    wz = rng.uniform(-STEP, STEP, (ticks + warmup, n)).astype(np.float32)
     pos = np.stack([np.array([e.position.x for e in ents], np.float32),
                     np.array([e.position.z for e in ents], np.float32)])
+
+    def run_ticks(start, count):
+        for t in range(start, start + count):
+            pos[0] = np.clip(pos[0] + wx[t], 0, cfg.world)
+            pos[1] = np.clip(pos[1] + wz[t], 0, cfg.world)
+            px, pz = pos[0], pos[1]
+            for i, e in enumerate(ents):
+                e.set_position(Vector3(px[i], 0.0, pz[i]))
+            rt.tick()
+
+    run_ticks(ticks, warmup)
     t0 = time.perf_counter()
-    for t in range(ticks):
-        pos[0] = np.clip(pos[0] + wx[t], 0, cfg.world)
-        pos[1] = np.clip(pos[1] + wz[t], 0, cfg.world)
-        px, pz = pos[0], pos[1]
-        for i, e in enumerate(ents):
-            e.set_position(Vector3(px[i], 0.0, pz[i]))
-        rt.tick()
+    run_ticks(0, ticks)
     dt = time.perf_counter() - t0
     return {
         "metric": "engine_moves_per_sec",
