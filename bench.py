@@ -95,15 +95,17 @@ def config_matrix():
         Config("unity1k", 1, 1024, 2000.0, 100.0, n_active=1000),
         # per-entity variable radius (asymmetric interest)
         Config("var_radius", S, CAP, WORLD, RADIUS, var_radius=True),
-        # Zipfian hotspot: 100k entities in one space, 90% in 1% of the map
-        Config("zipf100k", 1, 131072, 60000.0, 100.0, zipf=True,
-               n_active=100000, ticks=3, chunk=1, reps=1, cpu_ticks=1),
         # 1M entities across 64 spaces on one chip (a lax.scan chunk would
         # double-buffer the 2.1 GB carry; 1-tick chunks measured faster)
         Config("million", 64, 16384, 11314.0, 100.0,
                ticks=3, chunk=1, reps=1, cpu_ticks=1),
         # engine-level: Runtime.tick through the TPU bucket (host path)
         Config("engine", S, CAP, WORLD, RADIUS, ticks=5),
+        # Zipfian hotspot LAST: its 584k events/tick make it wire-bound on
+        # the dev tunnel (minutes/tick in bad weather) -- if the time
+        # budget truncates anything, let it be this one
+        Config("zipf100k", 1, 131072, 60000.0, 100.0, zipf=True,
+               n_active=100000, ticks=2, chunk=1, reps=1, cpu_ticks=1),
         # headline: 8 spaces x 8192, uniform density (BASELINE "8 x 10k");
         # extra reps because the recorded number rides the tunnel's weather
         Config("uniform", S, CAP, WORLD, RADIUS, reps=max(REPS, 5),
