@@ -90,7 +90,7 @@ def _fused_bucket_step(prev_all, *args):
     latency is per tick on the production path).
 
     ``args`` = (new_buf, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
-    slot_idx, x, z, r, act, max_chunks, kcap).  ``chg``/``new`` and the raw
+    slot_idx, x, z, r, act, max_chunks, kcap, max_gaps, max_exc).  ``chg``/``new`` and the raw
     grids are kept for cap-overflow recovery -- ``prev_all`` is donated, so
     the diff would otherwise be unrecoverable -- and ALL large outputs ride
     DONATED scratch buffers: returning a freshly allocated device array
@@ -107,18 +107,21 @@ def _fused_bucket_step(prev_all, *args):
 
         from ..ops.aoi_pallas import aoi_step_pallas
 
-        @functools.partial(jax.jit, static_argnames=("max_chunks", "kcap"),
-                           donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+        @functools.partial(
+            jax.jit,
+            static_argnames=("max_chunks", "kcap", "max_gaps", "max_exc"),
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         def impl(prev_all, new_buf, chg_buf, vals_buf, nv_buf, lane_buf,
-                 csel_buf, slot_idx, x, z, r, act, max_chunks, kcap):
+                 csel_buf, slot_idx, x, z, r, act, max_chunks, kcap,
+                 max_gaps, max_exc):
             prev_rows = prev_all[slot_idx]
             new, chg = aoi_step_pallas(x, z, r, act, prev_rows, emit="chg")
             prev_all = prev_all.at[slot_idx].set(new)
             vals, nv, lane, csel, ccnt, nd, mcc = EV.extract_chunks(
                 chg, max_chunks, kcap, aux=new, lanes=_LANES)
             enc = EV.encode_row_stream(vals, nv, lane, csel, ccnt,
-                                       w=_LANES, max_gaps=_MAX_GAPS,
-                                       max_exc=_MAX_EXC)
+                                       w=_LANES, max_gaps=max_gaps,
+                                       max_exc=max_exc)
             (rowb, bitpos, woff, base_row, n_esc, esc_rows,
              exc_gidx, exc_chg, exc_new, exc_n) = enc
             scalars = jnp.stack([nd, mcc, base_row, n_esc, exc_n])
@@ -391,6 +394,9 @@ class _TPUBucket(_Bucket):
         # donated scratch buffers, keyed (s_n, mc, kcap); replaced by each
         # flush's returns (same device memory, in-place)
         self._scratch: dict[tuple, tuple] = {}
+        # encode-side caps (instance attrs so overflow tests can shrink them)
+        self._max_gaps = _MAX_GAPS
+        self._max_exc = _MAX_EXC
         # device-resident copies of rarely-changing staged arrays, keyed by
         # array role; re-uploaded only when the host values change
         self._h2d_cache: dict[str, tuple] = {}
@@ -492,7 +498,8 @@ class _TPUBucket(_Bucket):
             )
         out = _fused_bucket_step(
             self.prev, *scratch, slot_idx, jnp.asarray(x), jnp.asarray(z),
-            self._h2d("r", r), self._h2d("act", act), mc, self._kcap
+            self._h2d("r", r), self._h2d("act", act), mc, self._kcap,
+            self._max_gaps, self._max_exc
         )
         (self.prev, new, chg, g_vals, g_nv, g_lane, g_csel,
          rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg, exc_new,
@@ -527,7 +534,7 @@ class _TPUBucket(_Bucket):
             gidx = np.nonzero(chg_h)[0]
             chg_vals = chg_h[gidx]
             ent_vals = chg_vals & new_h[gidx]
-        elif n_esc > _MAX_GAPS or exc_n > _MAX_EXC:
+        elif n_esc > self._max_gaps or exc_n > self._max_exc:
             # encode overflow (pathological churn): rebuild from the raw
             # grids kept on device
             ndp = min(mc, -(-max(nd, 1) // 512) * 512)
@@ -543,8 +550,8 @@ class _TPUBucket(_Bucket):
             # the common path fetches the ENCODED stream: ~5 B per dirty
             # chunk + 12 B per exception, overlapped slice transfers
             ndp = min(mc, -(-max(nd, 1) // 128) * 128)
-            escp = min(_MAX_GAPS, -(-max(n_esc, 1) // 64) * 64)
-            excp = min(_MAX_EXC, -(-max(exc_n, 1) // 256) * 256)
+            escp = min(self._max_gaps, -(-max(n_esc, 1) // 64) * 64)
+            excp = min(self._max_exc, -(-max(exc_n, 1) // 256) * 256)
             slices = (rowb[:ndp], bitpos[:ndp], woff[:ndp],
                       esc_rows[:escp], exc_gidx[:excp], exc_chg[:excp],
                       exc_new[:excp])

@@ -104,3 +104,67 @@ def test_unstaged_space_keeps_state():
         eng.flush()
         e, l = eng.take_events(h1)
         assert len(e) == 0 and len(l) == 0, f"{backend}: lost state while idle"
+
+
+def _run_pair(tpu_tweak=None, seed=5, cap=256, n=180, ticks=4):
+    """Drive cpu and tpu buckets identically; return per-tick event pairs."""
+    rng = np.random.default_rng(seed)
+    engines = {b: AOIEngine(default_backend=b) for b in ("cpu", "tpu")}
+    hs = {b: e.create_space(cap) for b, e in engines.items()}
+    if tpu_tweak is not None:
+        tpu_tweak(hs["tpu"].bucket)
+    xs = rng.uniform(0, 600, n).astype(np.float32)
+    zs = rng.uniform(0, 600, n).astype(np.float32)
+    rr = rng.uniform(60, 120, n).astype(np.float32)
+    act = np.zeros(cap, bool)
+    act[:n] = True
+
+    def pad(a):
+        o = np.zeros(cap, a.dtype)
+        o[:n] = a
+        return o
+
+    out = []
+    for _t in range(ticks):
+        xs += rng.uniform(-15, 15, n).astype(np.float32)
+        zs += rng.uniform(-15, 15, n).astype(np.float32)
+        evs = {}
+        for b, e in engines.items():
+            e.submit(hs[b], pad(xs), pad(zs), pad(rr), act.copy())
+            e.flush()
+            evs[b] = e.take_events(hs[b])
+        out.append(evs)
+    return out
+
+
+def test_tpu_encode_overflow_slow_path_parity():
+    """Shrinking the exception-stream cap forces the raw-grid slow path on
+    every tick; events must stay bit-identical to the CPU oracle (the slow
+    path is the correctness net for pathological churn)."""
+    def shrink(bucket):
+        bucket._max_exc = 4       # any multi-bit/tail word overflows
+        bucket._max_gaps = 4
+
+    for evs in _run_pair(tpu_tweak=shrink):
+        np.testing.assert_array_equal(evs["cpu"][0], evs["tpu"][0])
+        np.testing.assert_array_equal(evs["cpu"][1], evs["tpu"][1])
+
+
+def test_tpu_cap_overflow_full_diff_recovery_parity():
+    """Shrinking the extraction caps forces the full-diff download recovery;
+    events must stay bit-identical AND the caps must grow so later ticks
+    return to the device path."""
+    tweaked = []
+
+    def shrink(bucket):
+        # the flush floors mc at 512 chunks, far above this scene's 16 --
+        # the words-per-chunk cap is what forces the overflow here
+        bucket._kcap = 4
+        tweaked.append(bucket)
+
+    out = _run_pair(tpu_tweak=shrink, cap=256, n=220, ticks=4)
+    for evs in out:
+        np.testing.assert_array_equal(evs["cpu"][0], evs["tpu"][0])
+        np.testing.assert_array_equal(evs["cpu"][1], evs["tpu"][1])
+    # the recovery grew the per-chunk cap past the shrunken value
+    assert tweaked[0]._kcap > 4
