@@ -582,6 +582,8 @@ def run_config(cfg):
         "cpu_baseline_kind": cpu_kind,
         "tpu_ms_per_tick": round(tpu["ms_per_tick"], 2),
         "tpu_device_ms_per_tick": round(tpu["device_ms_per_tick"], 2),
+        "device_moves_per_sec": round(
+            cfg.moves_per_tick / tpu["device_ms_per_tick"] * 1e3),
         "cpu_baseline_moves_per_sec": round(cpu),
         "events_per_tick": round(tpu["events_per_tick"]),
         "overflow_ticks": tpu["overflow_ticks"],
