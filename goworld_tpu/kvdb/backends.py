@@ -249,8 +249,9 @@ class RedisClusterKVDB(RedisKVDB):
 
 
 class MongoKVDB(KVDBBackend):
-    """MongoDB kvdb (reference: kvdb/backend/kvdb_mongodb).  Gated on
-    pymongo (not in this image)."""
+    """MongoDB kvdb (reference: kvdb/backend/kvdb_mongodb).  pymongo when
+    installed, else the in-repo OP_MSG wire driver (ext/db/mongowire) --
+    see MongoEntityStorage."""
 
     config_kind = "server"
 
@@ -261,12 +262,13 @@ class MongoKVDB(KVDBBackend):
         if client is None:
             try:
                 import pymongo
-            except ImportError as e:
-                raise RuntimeError(
-                    "the mongodb kvdb backend requires the pymongo driver"
-                ) from e
-            client = pymongo.MongoClient(host, port)
-        # pymongo-compatible client; tests inject minimongo (see storage)
+
+                client = pymongo.MongoClient(host, port)
+            except ImportError:
+                from ..ext.db.mongowire import MongoWireClient
+
+                client = MongoWireClient(host, port)
+        # pymongo-compatible client; tests may also inject minimongo
         self._client = client
         self._col = self._client[db_name(db)]["kvdb"]
 

@@ -207,9 +207,12 @@ class RedisClusterEntityStorage(RedisEntityStorage):
 
 
 class MongoEntityStorage(EntityStorageBackend):
-    """MongoDB backend (reference: backend/mongodb/mongodb.go).  Gated on
-    the pymongo driver (not in this image); one collection per entity type,
-    documents ``{_id: eid, data: <bson-safe attrs>}``."""
+    """MongoDB backend (reference: backend/mongodb/mongodb.go).  One
+    collection per entity type, documents ``{_id: eid, data: <attrs>}``.
+    Uses pymongo when installed; otherwise the in-repo OP_MSG wire driver
+    (ext/db/mongowire.MongoWireClient), so the real socket/BSON path runs
+    even in a driverless image (hermetic tests pair it with
+    MiniMongoServer)."""
 
     config_kind = "server"
 
@@ -220,14 +223,14 @@ class MongoEntityStorage(EntityStorageBackend):
         if client is None:
             try:
                 import pymongo
-            except ImportError as e:
-                raise RuntimeError(
-                    "the mongodb storage backend requires the pymongo driver"
-                ) from e
-            client = pymongo.MongoClient(host, port)
-        # ``client`` is any pymongo-compatible client -- a real MongoClient
-        # or ext/db/minimongo.MiniMongoClient (how the hermetic tests run
-        # this backend's logic in a driverless image)
+
+                client = pymongo.MongoClient(host, port)
+            except ImportError:
+                from ..ext.db.mongowire import MongoWireClient
+
+                client = MongoWireClient(host, port)
+        # ``client`` is any pymongo-compatible client -- a real MongoClient,
+        # the wire driver above, or an injected in-process fake
         self._client = client
         self._db = self._client[db_name(db)]
 

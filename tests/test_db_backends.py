@@ -292,14 +292,17 @@ def test_mysql_backends_gated():
     _exercise_kvdb(kv)
 
 
-def test_gated_backend_error_message():
+def test_driverless_mongodb_uses_wire_driver():
+    """Without pymongo the mongodb backend falls back to the in-repo OP_MSG
+    wire driver (ext/db/mongowire) -- connecting is a real socket dial, so a
+    dead port raises a connection error, not a driver-gate RuntimeError."""
     try:
         import pymongo  # noqa: F401
-        pytest.skip("pymongo available; gate not exercised")
+        pytest.skip("pymongo available; fallback not exercised")
     except ImportError:
         pass
-    with pytest.raises(RuntimeError, match="pymongo"):
-        new_entity_storage("mongodb")
+    with pytest.raises(OSError):
+        new_entity_storage("mongodb", port=1)  # nothing listens on port 1
 
 
 # -- mongodb / mysql backends through injected fakes -------------------------
