@@ -369,6 +369,38 @@ class DispatcherService:
 
     _h_call_entity_method_from_client = _h_call_entity_method
 
+    def _h_call_entities_batch(self, peer, pkt):
+        """Grouped entity-RPC fanout (pubsub publish): split the eid list by
+        owning game and forward ONE batch packet per game.  Eids that are
+        unknown, blocked, or behind a pending queue fall back to individual
+        MT_CALL_ENTITY_METHOD packets so they ride the per-entity
+        block/replay ordering machinery unchanged."""
+        method = pkt.read_varstr()
+        args_wire = pkt.read_varbytes()
+        n = pkt.read_u32()
+        now = time.monotonic()
+        per_game: dict[int, list[str]] = {}
+        for _ in range(n):
+            eid = pkt.read_entity_id()
+            ei = self.entities.get(eid)
+            if (ei is None or ei.game_id == 0 or ei.blocked(now)
+                    or ei.pending):
+                sp = Packet.for_msgtype(MT.MT_CALL_ENTITY_METHOD)
+                sp.append_entity_id(eid)
+                sp.append_varstr(method)
+                sp.append_bytes(args_wire)
+                self._dispatch_entity_packet(eid, sp)
+                continue
+            per_game.setdefault(ei.game_id, []).append(eid)
+        for gid, eids in per_game.items():
+            gp = Packet.for_msgtype(MT.MT_CALL_ENTITIES_BATCH)
+            gp.append_varstr(method)
+            gp.append_varbytes(args_wire)
+            gp.append_u32(len(eids))
+            for eid in eids:
+                gp.append_entity_id(eid)
+            self._send_to_game(gid, gp)
+
     def _h_give_client_to(self, peer, pkt):
         """Client handoff routes like an entity call (by target shard,
         queued while the target loads/migrates) -- but a handoff for an eid
@@ -637,6 +669,7 @@ class DispatcherService:
         MT.MT_LOAD_ENTITY_ANYWHERE: _h_load_entity_anywhere,
         MT.MT_CALL_ENTITY_METHOD: _h_call_entity_method,
         MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT: _h_call_entity_method_from_client,
+        MT.MT_CALL_ENTITIES_BATCH: _h_call_entities_batch,
         MT.MT_GIVE_CLIENT_TO: _h_give_client_to,
         MT.MT_CALL_NIL_SPACES: _h_call_nil_spaces,
         MT.MT_SYNC_POSITION_YAW_FROM_CLIENT: _h_sync_from_client,

@@ -194,3 +194,12 @@ class Packet:
 
     def __len__(self) -> int:
         return len(self.buf)
+
+
+def pack_args(args: tuple, packer=None) -> bytes:
+    """The ``append_args`` wire encoding as raw bytes -- lets a batched
+    fanout pack its args ONCE and splice them into per-shard/per-game
+    packets without re-serializing."""
+    p = Packet(bytearray())
+    p.append_args(args, packer)
+    return bytes(p.buf)

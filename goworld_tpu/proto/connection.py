@@ -113,6 +113,19 @@ class GWConnection:
         p.append_args(args)
         self.send(p)
 
+    def send_call_entities_batch(self, eids, method: str, args_wire: bytes):
+        """One packet carrying one RPC for MANY entities (batched fanout --
+        pubsub publish and friends).  ``args_wire`` is the raw
+        ``append_args`` encoding (netutil.packet.pack_args) so the
+        dispatcher re-slices the batch per game without unpacking it."""
+        p = Packet.for_msgtype(MT.MT_CALL_ENTITIES_BATCH)
+        p.append_varstr(method)
+        p.append_varbytes(args_wire)
+        p.append_u32(len(eids))
+        for eid in eids:
+            p.append_entity_id(eid)
+        self.send(p)
+
     def send_give_client_to(self, target_eid: str, client_id: str,
                             gate_id: int):
         """Hand client ownership to an entity on (possibly) another game;
