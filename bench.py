@@ -544,7 +544,11 @@ def bench_cpu(cfg, xs, zs):
 
     s, cap = cfg.s, cfg.cap
     if aoi_native.available():
-        oracles = [aoi_native.NativeAOIOracle(cap) for _ in range(s)]
+        # the BASELINE is pinned to the sweep -- the compiled equivalent of
+        # the reference's go-aoi XZList data structure.  (The native
+        # calculator's grid mode is our own optimization; the engine config
+        # reports it separately as cpp_grid.)
+        oracles = [aoi_native.NativeAOIOracle(cap, "sweep") for _ in range(s)]
         kind = "cpp-sweep"
         ticks = min(max(cfg.cpu_ticks, 2), xs.shape[0] - 1)
     else:

@@ -86,7 +86,9 @@ class Monster(Entity):
         self.add_timer(0.1, "ai_tick")
 
     def ai_tick(self):
-        prey = [e for e in self.interested_in if e.type_name == "Player"]
+        # neighbors() is the lazy-aware accessor: a hook-less clientless
+        # entity's interests live in the calculator's packed words
+        prey = [e for e in self.neighbors() if e.type_name == "Player"]
         if not prey:
             return
         target = min(prey, key=lambda p: p.position.distance_to(self.position))

@@ -50,6 +50,8 @@ class GameService:
         self.rt = Runtime(
             aoi_backend=self.gcfg.aoi_backend,
             on_error=lambda e: self.log.exception("entity error", exc_info=e),
+            aoi_mesh=self.gcfg.aoi_mesh_devices or None,
+            aoi_pipeline=self.gcfg.aoi_pipeline,
         )
         self.rt.on_entity_registered = self._on_entity_registered
         self.rt.on_entity_unregistered = self._on_entity_unregistered
@@ -271,6 +273,22 @@ class GameService:
             self.log.warning("call %s on missing entity %s", method, eid)
             return
         gwutils.run_panicless(e.call, method, *args, logger=self.log)
+
+    def _h_call_entities_batch(self, pkt):
+        """One RPC delivered to many local entities (the dispatcher already
+        grouped the eid list per game).  Args are re-unpacked PER TARGET so
+        a callee mutating a container argument cannot leak the mutation into
+        later callees -- the same isolation N individual call packets gave."""
+        method = pkt.read_varstr()
+        args_wire = bytearray(pkt.read_varbytes())
+        ap = Packet(args_wire)
+        n = pkt.read_u32()
+        for _ in range(n):
+            e = self.rt.entities.get(pkt.read_entity_id())
+            if e is not None:
+                ap.rpos = 0
+                args = ap.read_args()
+                gwutils.run_panicless(e.call, method, *args, logger=self.log)
 
     def _h_call_entity_method_from_client(self, pkt):
         eid = pkt.read_entity_id()
@@ -495,6 +513,7 @@ class GameService:
         MT.MT_NOTIFY_CLIENT_DISCONNECTED: _h_client_disconnected,
         MT.MT_CALL_ENTITY_METHOD: _h_call_entity_method,
         MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT: _h_call_entity_method_from_client,
+        MT.MT_CALL_ENTITIES_BATCH: _h_call_entities_batch,
         MT.MT_GIVE_CLIENT_TO: _h_give_client_to,
         MT.MT_CALL_NIL_SPACES: _h_call_nil_spaces,
         MT.MT_SYNC_POSITION_YAW_FROM_CLIENT: _h_sync_from_client,
@@ -627,6 +646,34 @@ class GameService:
         if conn:
             conn.send_call_entity_method(eid, method, args)
 
+    def call_entities_batch(self, eids, method: str, *args):
+        """Fan one RPC out to many entities with ONE packet per dispatcher
+        shard, split per game by the dispatcher (the pubsub publish path --
+        contrast with one dispatcher packet per subscriber).  Local entities
+        dispatch directly; per-entity ordering is preserved because a batch
+        rides the same shard its members' single calls would."""
+        from ...netutil.packet import pack_args
+
+        remote: list[str] = []
+        for eid in eids:
+            e = self.rt.entities.get(eid)
+            if e is not None:
+                self.rt.post.post(
+                    lambda e=e: gwutils.run_panicless(
+                        e.call, method, *args, logger=self.log))
+            else:
+                remote.append(eid)
+        if not remote:
+            return
+        args_wire = pack_args(args)
+        groups: dict[int, tuple] = {}
+        for eid in remote:
+            conn = self.cluster.by_entity(eid)
+            if conn:
+                groups.setdefault(id(conn), (conn, []))[1].append(eid)
+        for conn, shard_eids in groups.values():
+            conn.send_call_entities_batch(shard_eids, method, args_wire)
+
     def create_entity_anywhere(self, type_name: str, attrs: dict | None = None) -> str:
         eid = gen_id()
         conn = self.cluster.by_entity(eid)
@@ -702,14 +749,16 @@ class GameService:
         for e in self.rt.entities.entities.values():
             gwutils.run_panicless(e.on_freeze, logger=self.log)
             d = e.migrate_data()
-            if e.interested_in:
-                # interest sets are part of the checkpoint: restore rebuilds
-                # them directly and seeds the AOI calculator's previous-tick
-                # state, so the first post-restore flush emits ONLY genuine
-                # diffs (changes that happened while frozen) -- no
-                # suppression heuristics (reference: quiet restore,
-                # EntityManager.go:591-652)
-                d["interests"] = [o.id for o in e.interested_in]
+            # interest sets are part of the checkpoint: restore rebuilds
+            # them and seeds the AOI calculator's previous-tick state, so
+            # the first post-restore flush emits ONLY genuine diffs (changes
+            # that happened while frozen) -- no suppression heuristics
+            # (reference: quiet restore, EntityManager.go:591-652).
+            # neighbors() is the lazy-aware accessor; gating on the eager
+            # set would skip every plain entity's interests
+            interest_ids = [o.id for o in e.neighbors()]
+            if interest_ids:
+                d["interests"] = interest_ids
             if e.is_space:
                 d["kind"] = getattr(e, "kind", 0)
                 d["aoi_dist"] = getattr(e, "_aoi_default_dist", 0.0)
@@ -784,6 +833,11 @@ class GameService:
             # space's AOI previous-tick words so the first flush diffs
             # against the frozen state instead of replaying every pair
             for e, ids in pending_interests:
+                # PLAIN entities stay lazy -- their interests live only in
+                # the seeded packed words below; eager sets are rebuilt just
+                # for entities with clients/hooks
+                if e._plain_aoi:
+                    continue
                 for oid in ids:
                     other = self.rt.entities.get(oid)
                     if other is None:
@@ -795,18 +849,23 @@ class GameService:
             from ...ops import aoi_predicate as AP
             import numpy as np
 
+            by_space: dict = {}
+            for e, ids in pending_interests:
+                if e.space is not None and e.aoi_slot >= 0:
+                    by_space.setdefault(id(e.space), []).append((e, ids))
             for sp in id2space.values():
                 h = sp._aoi_handle
                 if h is None:
                     continue
                 cap = h.capacity
-                # build the packed words directly: O(pairs), not O(cap^2)
+                # build the packed words directly from the frozen interest
+                # lists: O(pairs), not O(cap^2) and not O(spaces x entities)
                 words = np.zeros((cap, AP.words_per_row(cap)), np.uint32)
-                for e in sp.entities:
-                    if e.aoi_slot < 0:
-                        continue
-                    for other in e.interested_in:
-                        if other.aoi_slot >= 0:
+                for e, ids in by_space.get(id(sp), ()):
+                    for oid in ids:
+                        other = self.rt.entities.get(oid)
+                        if other is not None and other.aoi_slot >= 0 \
+                                and other.space is sp:
                             w, b = AP.word_bit_for_column(
                                 other.aoi_slot, cap)
                             words[e.aoi_slot, w] |= np.uint32(1) << np.uint32(b)

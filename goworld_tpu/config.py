@@ -45,6 +45,12 @@ class DispatcherConfig:
 @dataclass
 class GameConfig:
     aoi_backend: str = "cpu"  # cpu (python sweep) | cpp (native sweep) | tpu
+    # >0 with aoi_backend=tpu: shard every tpu bucket's spaces over an
+    # N-device mesh (engine/aoi_mesh); 0 = single device
+    aoi_mesh_devices: int = 0
+    # double-buffer the tpu flush: AOI events arrive one tick late, device
+    # and D2H time overlap the host tick (engine/aoi._TPUBucket docstring)
+    aoi_pipeline: bool = False
     tick_interval_ms: int = consts.TICK_INTERVAL_MS
     position_sync_interval_ms: int = consts.POSITION_SYNC_INTERVAL_MS
     save_interval_s: int = consts.ENTITY_SAVE_INTERVAL_S

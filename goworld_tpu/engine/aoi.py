@@ -79,6 +79,15 @@ _MAX_GAPS = 2048    # escaped chunk-index deltas per flush
 _MAX_EXC = 32768    # exception triples (tail + multi-bit words) per flush
 
 
+def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:
+    """(space_row, i, j) triples -> {space_row: (i, j) pairs}."""
+    out: dict[int, np.ndarray] = {}
+    if len(tri):
+        for s in np.unique(tri[:, 0]):
+            out[int(s)] = tri[tri[:, 0] == s][:, 1:]
+    return out
+
+
 def _fused_bucket_step(prev_all, *args):
     """One device program per bucket flush: gather staged slots' previous
     words, run the fused AOI kernel, scatter the new words back, compact the
@@ -149,11 +158,33 @@ class SpaceAOIHandle:
 
 
 class AOIEngine:
-    """Per-process registry of AOI state, bucketed by (backend, capacity)."""
+    """Per-process registry of AOI state, bucketed by (backend, capacity).
 
-    def __init__(self, default_backend: str = "cpu", oracle_algorithm: str = "sweep"):
+    ``mesh`` (a :class:`goworld_tpu.parallel.SpaceMesh`, or an int device
+    count) shards every tpu bucket's spaces over the mesh's 'space' axis --
+    the engine-level multi-chip path (see engine/aoi_mesh).  Without it, tpu
+    buckets are single-device."""
+
+    def __init__(self, default_backend: str = "cpu",
+                 oracle_algorithm: str = "sweep", mesh=None,
+                 pipeline: bool = False):
         self.default_backend = default_backend
         self.oracle_algorithm = oracle_algorithm
+        if isinstance(mesh, int):
+            from ..parallel import SpaceMesh, multichip_devices
+
+            mesh = SpaceMesh(multichip_devices(mesh))
+        self.mesh = mesh
+        # double-buffered tpu flush: events arrive one tick late, D2H
+        # overlaps the host tick (SURVEY §7(d); see _TPUBucket docstring)
+        self.pipeline = pipeline
+        if pipeline and mesh is not None:
+            from ..utils import gwlog
+
+            gwlog.logger("gw.aoi").warning(
+                "aoi_pipeline is not implemented for mesh buckets yet -- "
+                "mesh flushes run synchronously (events same-tick)"
+            )
         self._buckets: dict[tuple[str, int], _Bucket] = {}
         if default_backend == "tpu":
             # fail FAST at process boot, not on the first space's first
@@ -192,7 +223,11 @@ class AOIEngine:
                 from ..ops import aoi_native
 
                 if aoi_native.available():
-                    bucket = _CPUBucket(capacity, self.oracle_algorithm,
+                    # "auto" = grid candidate binning when the layout
+                    # supports it, sweep otherwise (bit-exact either way);
+                    # the production host calculator should always take the
+                    # cheaper enumeration
+                    bucket = _CPUBucket(capacity, "auto",
                                         oracle_cls=aoi_native.NativeAOIOracle)
                 else:
                     # LOUD fallback (results are bit-identical, only slower)
@@ -204,7 +239,12 @@ class AOIEngine:
                     )
                     bucket = _CPUBucket(capacity, self.oracle_algorithm)
             elif backend == "tpu":
-                bucket = _TPUBucket(capacity)
+                if self.mesh is not None:
+                    from .aoi_mesh import _MeshTPUBucket
+
+                    bucket = _MeshTPUBucket(capacity, self.mesh)
+                else:
+                    bucket = _TPUBucket(capacity, pipeline=self.pipeline)
             else:
                 raise ValueError(f"unknown AOI backend {backend!r}")
             self._buckets[key] = bucket
@@ -224,9 +264,18 @@ class AOIEngine:
 
     def flush(self) -> None:
         """Execute all staged steps (one batched kernel per bucket); results
-        are then available per space via :meth:`take_events`."""
+        are then available per space via :meth:`take_events` (one tick late
+        when pipelined)."""
         for bucket in self._buckets.values():
             bucket.flush()
+
+    def has_pending(self) -> bool:
+        """True when a pipelined bucket holds a dispatched-but-unharvested
+        tick (the runtime must keep flushing until it drains)."""
+        return any(
+            getattr(b, "_inflight", None) is not None
+            for b in self._buckets.values()
+        )
 
     def take_events(self, h: SpaceAOIHandle):
         """(enter_pairs, leave_pairs) for this space from the last flush."""
@@ -309,6 +358,16 @@ class _Bucket:
     def flush(self) -> None:
         raise NotImplementedError
 
+    def drain(self) -> None:
+        """Deliver any pipelined tick still in flight (no-op by default)."""
+
+    def peek_words(self, slot: int) -> np.ndarray | None:
+        """Current interest words [C, W] for a slot WITHOUT forcing a device
+        round trip -- the backing store for lazily derived interest sets
+        (Space.derive_interests).  None when no cheap host copy exists yet
+        (the caller then falls back to :meth:`get_prev`)."""
+        return None
+
     def get_prev(self, slot: int) -> np.ndarray:
         """Previous-tick interest words [C, W] for state carry-over."""
         raise NotImplementedError
@@ -346,6 +405,9 @@ class _CPUBucket(_Bucket):
             self._events[slot] = self._oracles[slot].step(x, z, r, act)
         self._staged.clear()
 
+    def peek_words(self, slot: int) -> np.ndarray:
+        return self._oracles[slot].prev_words
+
     def get_prev(self, slot: int) -> np.ndarray:
         return self._oracles[slot].prev_words.copy()
 
@@ -370,10 +432,31 @@ class _TPUBucket(_Bucket):
     previous words are carried forward untouched (active=False would wipe
     them, so unstaged slots are skipped via a host-side mask and their
     prev rows rewritten unchanged).
+
+    ``pipeline=True`` double-buffers the flush (SURVEY §7 hard part (d)):
+    ``flush()`` dispatches tick T's device step and then harvests tick T-1's
+    results -- whose scalar+stream D2H transfers were issued asynchronously
+    at T-1's dispatch with optimistically sized slices, so the wire time
+    overlaps the whole host tick between the two flushes.  Events are
+    therefore delivered ONE TICK LATE (the documented latency/throughput
+    trade; parity is bit-exact modulo the shift -- tests/test_aoi_engine.py
+    test_pipelined_flush_parity).  ``drain()`` harvests a pending tick
+    without dispatching a new one (shutdown, state carry-over, tests).
     """
 
-    def __init__(self, capacity: int):
+    def __init__(self, capacity: int, pipeline: bool = False):
         super().__init__(capacity)
+        self.pipeline = pipeline
+        self._inflight = None  # pending dispatch awaiting harvest
+        # per-slot release epoch: a pipelined harvest must NOT publish
+        # events for a slot released (and possibly reused) after its
+        # dispatch -- the new occupant would replay the dead space's pairs
+        self._slot_epoch: dict[int, int] = {}
+        # mirror maintenance ops (clears/resets) issued while a dispatched
+        # tick is still in flight: they postdate that tick's change stream,
+        # so they must apply AFTER its XOR at harvest, not immediately --
+        # else the XOR re-plants bits the clear just removed
+        self._mirror_ops: list[tuple] = []
         import jax.numpy as jnp
 
         self._jnp = jnp
@@ -397,6 +480,14 @@ class _TPUBucket(_Bucket):
         # encode-side caps (instance attrs so overflow tests can shrink them)
         self._max_gaps = _MAX_GAPS
         self._max_exc = _MAX_EXC
+        # optimistic prefetch sizes for the pipelined path (rows, escapes,
+        # exceptions) -- refit to each harvested tick
+        self._pred = (512, 64, 256)
+        # host mirror of the interest words, enabled lazily on the first
+        # peek_words (lazy interest-set derivation): one device fetch to
+        # seed, then one vectorized XOR of each harvested tick's change
+        # stream -- no per-tick fetches
+        self._mirror: np.ndarray | None = None
         # device-resident copies of rarely-changing staged arrays, keyed by
         # array role; re-uploaded only when the host values change
         self._h2d_cache: dict[str, tuple] = {}
@@ -412,17 +503,40 @@ class _TPUBucket(_Bucket):
         if self.prev is not None and self.s_max > 0:
             new_prev = new_prev.at[: self.s_max].set(self.prev)
         self.prev = new_prev
+        if self._mirror is not None:
+            grown = np.zeros((new_s, self.capacity, self.W), np.uint32)
+            grown[: self._mirror.shape[0]] = self._mirror
+            self._mirror = grown
         self.s_max = new_s
 
     def _reset_slot(self, slot: int) -> None:
         self._pending_reset.add(slot)
+        self._mirror_apply(("reset", slot))
+
+    def peek_words(self, slot: int) -> np.ndarray:
+        """Host mirror of the slot's interest words.  First call seeds the
+        mirror with one device fetch (after draining any pipelined tick so
+        mirror and delivered events agree); afterwards each harvest keeps it
+        current with a vectorized XOR of the decoded change stream."""
+        if self._mirror is None:
+            self.drain()
+            # ascontiguousarray matters: a fetched device array can carry
+            # the TPU's tiled strides, and a non-C-contiguous mirror would
+            # make the harvest's reshape-XOR write to a silent copy
+            self._mirror = (np.zeros((self.s_max, self.capacity, self.W),
+                                     np.uint32)
+                            if self.prev is None
+                            else np.ascontiguousarray(np.asarray(self.prev)))
+        return self._mirror[slot]
 
     def flush(self) -> None:
         if not self._staged and not self._pending_reset and not self._pending_clear:
+            # pipelined: a tick with nothing new still delivers the pending
+            # tick's events (trailing flush)
+            if self._inflight is not None:
+                self._harvest()
             return
         import jax.numpy as jnp
-
-        from ..ops.aoi_pallas import aoi_step_pallas
 
         c = self.capacity
         if self._pending_reset:
@@ -461,6 +575,8 @@ class _TPUBucket(_Bucket):
                 jnp.asarray([m for _, _, m in cols], jnp.uint32),
             )
         if not self._staged:
+            if self._inflight is not None:
+                self._harvest()
             return
 
         slots = sorted(self._staged)
@@ -485,7 +601,9 @@ class _TPUBucket(_Bucket):
         scratch = self._scratch.pop(key, None)
         if scratch is None:
             # keep a few shape variants so alternating staged-slot counts
-            # still reuse donated memory; evict oldest beyond that
+            # still reuse donated memory; evict oldest beyond that.  The
+            # pipeline holds one extra set in flight, so the pool plus the
+            # inflight record double-buffer naturally.
             while len(self._scratch) >= 4:
                 self._scratch.pop(next(iter(self._scratch)))
             scratch = (
@@ -504,11 +622,62 @@ class _TPUBucket(_Bucket):
         (self.prev, new, chg, g_vals, g_nv, g_lane, g_csel,
          rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg, exc_new,
          scalars) = out
-        self._scratch[key] = (new, chg, g_vals, g_nv, g_lane, g_csel)
+        scalars.copy_to_host_async()
+        rec = {
+            "slots": slots, "s_n": s_n, "key": key, "mc": mc,
+            "kcap": self._kcap,
+            "epochs": [self._slot_epoch.get(s, 0) for s in slots],
+            "scratch": (new, chg, g_vals, g_nv, g_lane, g_csel),
+            "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+                        exc_new),
+            "scalars": scalars,
+            "prefetch": None,
+        }
+        if self.pipeline:
+            # optimistic prefetch at the recent ticks' observed stream sizes:
+            # the D2H rides the wire while the host runs the next tick's
+            # logic; the harvest refetches exact slices on a misfit (rare --
+            # sizes move slowly in steady state)
+            ndp = min(mc, self._pred[0])
+            escp = min(self._max_gaps, self._pred[1])
+            excp = min(self._max_exc, self._pred[2])
+            slices = (rowb[:ndp], bitpos[:ndp], woff[:ndp],
+                      esc_rows[:escp], exc_gidx[:excp], exc_chg[:excp],
+                      exc_new[:excp])
+            for a in slices:
+                a.copy_to_host_async()
+            rec["prefetch"] = (ndp, escp, excp, slices)
+        prev_rec, self._inflight = self._inflight, rec
+        if self.pipeline:
+            if prev_rec is not None:
+                self._harvest(prev_rec)
+        else:
+            self._harvest()
+
+    def drain(self) -> None:
+        """Harvest a pending pipelined tick without dispatching a new one
+        (shutdown, state carry-over, tests)."""
+        if self._inflight is not None:
+            self._harvest()
+
+    def _harvest(self, rec=None) -> None:
+        """Fetch + decode one dispatched tick's event stream and publish its
+        per-slot events.  ``rec=None`` harvests (and clears) the inflight
+        record."""
+        if rec is None:
+            rec, self._inflight = self._inflight, None
+        slots, s_n, mc = rec["slots"], rec["s_n"], rec["mc"]
+        kcap = rec["kcap"]
+        c = self.capacity
+        (new, chg, g_vals, g_nv, g_lane, g_csel) = rec["scratch"]
+        (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+         exc_new) = rec["streams"]
         # ONE tiny fetch for all control scalars (each synchronous fetch
-        # pays a round trip when the chip is reached over a network tunnel)
+        # pays a round trip when the chip is reached over a network tunnel);
+        # under the pipeline it was issued async at dispatch and is local by
+        # now
         nd, mcc, base_row, n_esc, exc_n = (int(v) for v in
-                                           np.asarray(scalars))
+                                           np.asarray(rec["scalars"]))
         self._peak_nd = max(self._peak_nd, nd)
         self._peak_mcc = max(self._peak_mcc, mcc)
         self._flushes += 1
@@ -523,7 +692,7 @@ class _TPUBucket(_Bucket):
                 self._kcap = min(self._kcap, fit_k)
             self._peak_nd = self._peak_mcc = 0
             self._flushes = 0
-        if nd > mc or mcc > self._kcap:
+        if nd > mc or mcc > kcap:
             # caps exceeded: recover this tick from the full diff, then grow
             # the caps so the next tick extracts on device again
             self._max_chunks = max(self._max_chunks, 2 * nd)
@@ -549,29 +718,90 @@ class _TPUBucket(_Bucket):
         else:
             # the common path fetches the ENCODED stream: ~5 B per dirty
             # chunk + 12 B per exception, overlapped slice transfers
-            ndp = min(mc, -(-max(nd, 1) // 128) * 128)
-            escp = min(self._max_gaps, -(-max(n_esc, 1) // 64) * 64)
-            excp = min(self._max_exc, -(-max(exc_n, 1) // 256) * 256)
-            slices = (rowb[:ndp], bitpos[:ndp], woff[:ndp],
-                      esc_rows[:escp], exc_gidx[:excp], exc_chg[:excp],
-                      exc_new[:excp])
-            for a in slices:
-                a.copy_to_host_async()
-            hb = [np.asarray(a) for a in slices]
+            pf = rec["prefetch"]
+            if pf is not None and pf[0] >= nd and pf[1] >= n_esc \
+                    and pf[2] >= exc_n:
+                hb = [np.asarray(a) for a in pf[3]]
+            else:
+                ndp = min(mc, -(-max(nd, 1) // 128) * 128)
+                escp = min(self._max_gaps, -(-max(n_esc, 1) // 64) * 64)
+                excp = min(self._max_exc, -(-max(exc_n, 1) // 256) * 256)
+                slices = (rowb[:ndp], bitpos[:ndp], woff[:ndp],
+                          esc_rows[:escp], exc_gidx[:excp], exc_chg[:excp],
+                          exc_new[:excp])
+                for a in slices:
+                    a.copy_to_host_async()
+                hb = [np.asarray(a) for a in slices]
             chg_vals, ent_vals, gidx = EV.decode_row_stream(
                 hb[0], hb[1], hb[2].astype(np.uint16), base_row, nd,
                 _LANES, hb[3], hb[4], hb[5], hb[6])
+        # refit the next dispatch's optimistic prefetch to this tick
+        self._pred = (
+            max(512, -(-nd * 5 // 4 // 128) * 128),
+            max(64, -(-(n_esc + 1) * 3 // 2 // 64) * 64),
+            max(256, -(-(exc_n + 1) * 5 // 4 // 256) * 256),
+        )
+        if self._mirror is not None:
+            if len(gidx):
+                # stream entries are whole words with unique indices, so one
+                # fancy-index XOR applies the tick exactly
+                wps = c * self.W
+                gidx = np.asarray(gidx, np.int64)
+                srows = np.asarray(slots, np.int64)[gidx // wps]
+                self._mirror.reshape(self.s_max, wps)[srows, gidx % wps] ^= \
+                    chg_vals
+            if self._mirror_ops:
+                # clears/resets issued after this tick's dispatch apply now,
+                # AFTER its stream (see _mirror_apply).  Applied directly:
+                # the NEXT tick may already be in flight, and re-deferring
+                # would postpone them forever.
+                ops, self._mirror_ops = self._mirror_ops, []
+                for op in ops:
+                    self._mirror_apply_now(op)
+        # the harvested scratch set returns to the pool for reuse
+        self._scratch.setdefault(rec["key"], rec["scratch"])
         pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx, c, s_n)
-        ent_rows = self._split_rows(pe)
-        lv_rows = self._split_rows(pl)
+        ent_rows = _split_rows(pe)
+        lv_rows = _split_rows(pl)
         empty = np.empty((0, 2), np.int32)
-        for row, slot in enumerate(slots):
+        for row, (slot, epoch) in enumerate(zip(slots, rec["epochs"])):
+            if self._slot_epoch.get(slot, 0) != epoch:
+                # slot released (and possibly reused) since this tick was
+                # dispatched: its events belong to a dead space
+                continue
             e = ent_rows.get(row, empty)
             l = lv_rows.get(row, empty)
             self._events[slot] = (e, l)
 
+    def release_slot(self, slot: int) -> None:
+        self._slot_epoch[slot] = self._slot_epoch.get(slot, 0) + 1
+        super().release_slot(slot)
+
     def clear_entity(self, slot: int, entity_slot: int) -> None:
         self._pending_clear.append((slot, entity_slot))
+        self._mirror_apply(("clear", slot, entity_slot))
+
+    def _mirror_apply(self, op: tuple) -> None:
+        """Apply (or defer) one mirror maintenance op.  With a tick in
+        flight the op postdates that tick's stream, so it queues and runs
+        after the harvest XOR; otherwise it applies immediately so
+        derivations before the next flush already see it."""
+        if self._mirror is None:
+            return
+        if self._inflight is not None:
+            self._mirror_ops.append(op)
+            return
+        self._mirror_apply_now(op)
+
+    def _mirror_apply_now(self, op: tuple) -> None:
+        if op[0] == "reset":
+            self._mirror[op[1]] = 0
+        else:
+            _slot, e = op[1], op[2]
+            self._mirror[_slot, e, :] = 0
+            w, b = P.word_bit_for_column(e, self.capacity)
+            self._mirror[_slot, :, w] &= np.uint32(
+                ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
 
     def _h2d(self, role: str, arr: np.ndarray):
         """Upload a staged array only when its values changed since the last
@@ -595,12 +825,6 @@ class _TPUBucket(_Bucket):
         self.flush()
         self._pending_reset.discard(slot)
         self.prev = self.prev.at[slot].set(self._jnp.asarray(words, self._jnp.uint32))
+        if self._mirror is not None:
+            self._mirror[slot] = np.asarray(words, np.uint32)
 
-    @staticmethod
-    def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:
-        """(space_row, i, j) triples -> {space_row: (i, j) pairs}."""
-        out: dict[int, np.ndarray] = {}
-        if len(tri):
-            for s in np.unique(tri[:, 0]):
-                out[int(s)] = tri[tri[:, 0] == s][:, 1:]
-        return out

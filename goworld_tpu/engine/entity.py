@@ -119,6 +119,12 @@ class Entity:
         self._sync_flags = 0
         self._attr_deltas: list[tuple] = []  # (path, op, value) this tick
         self.destroyed = False
+        # hot-path caches, set by EntityManager.create: the runtime's stable
+        # dirty-set object, and whether AOI event replay for this entity is
+        # pure set bookkeeping (no client, default hooks -- the batched fast
+        # path in Space.dispatch_aoi_events)
+        self._dirty_set: set | None = None
+        self._plain_aoi = True
 
     # ------------------------------------------------------------------ api
     def _mark_dirty(self):
@@ -126,9 +132,21 @@ class Entity:
         touches only entities that actually changed (the reference's
         CollectEntitySyncInfos scans every entity each tick, Entity.go:1221
         -- compiled Go affords that; a host-language tick loop does not)."""
-        m = self.manager
-        if m is not None:
-            m.runtime._dirty_entities.add(self)
+        s = self._dirty_set
+        if s is not None:
+            s.add(self)
+
+    def _recompute_plain(self):
+        if self.desc is not None:
+            self._plain_aoi = self.client is None and self.desc.plain_aoi_hooks
+        else:
+            cls = type(self)
+            self._plain_aoi = self.client is None and (
+                cls.on_enter_aoi is Entity.on_enter_aoi
+                and cls.on_leave_aoi is Entity.on_leave_aoi
+            )
+        if self.aoi_slot >= 0 and self.space is not None:
+            self.space._nonplain[self.aoi_slot] = not self._plain_aoi
 
     @property
     def is_space(self) -> bool:
@@ -229,15 +247,25 @@ class Entity:
 
     # -- position / AOI ----------------------------------------------------
     def set_position(self, pos: Vector3):
-        if self.space is not None:
-            self.space.move_entity(self, pos)
+        # the single hottest host call in the engine (once per entity move
+        # per tick); space.move_entity is inlined and the dirty-set add uses
+        # the cached stable set
+        self.position = pos
+        sp = self.space
+        if sp is not None:
+            slot = self.aoi_slot
+            if slot >= 0:
+                sp._x[slot] = pos.x
+                sp._z[slot] = pos.z
+                sp._aoi_dirty = True
+        if self.client_syncing:
+            self._sync_flags |= SYNC_NEIGHBORS
         else:
-            self.position = pos
-        self._sync_flags |= SYNC_NEIGHBORS
-        if not self.client_syncing:
             # server-driven move must also correct the owner client
-            self._sync_flags |= SYNC_OWN
-        self._mark_dirty()
+            self._sync_flags |= SYNC_OWN | SYNC_NEIGHBORS
+        s = self._dirty_set
+        if s is not None:
+            s.add(self)
 
     def set_yaw(self, yaw: float):
         self.yaw = float(yaw)
@@ -285,7 +313,43 @@ class Entity:
         self.on_leave_aoi(other)
 
     def neighbors(self) -> Iterable["Entity"]:
+        """Entities this one is currently interested in (as of the last AOI
+        flush).  PLAIN entities -- no client, default hooks -- derive the
+        answer from the calculator's packed interest words on demand; their
+        ``interested_in``/``interested_by`` sets are intentionally EMPTY
+        (event replay for them is a vectorized no-op).  Entities with a
+        client or overridden hooks keep eagerly maintained sets."""
+        if self._plain_aoi and self.aoi_slot >= 0 and self.space is not None:
+            return self.space.derive_interests(self.aoi_slot)
         return self.interested_in
+
+    def observers(self) -> Iterable["Entity"]:
+        """Entities currently interested in this one (see neighbors)."""
+        if self.aoi_slot >= 0 and self.space is not None \
+                and self.space.aoi_enabled:
+            return self.space.derive_observers(self.aoi_slot)
+        return self.interested_by
+
+    def _materialize_interests(self):
+        """Promote lazily tracked interests into the eager sets -- called
+        when a plain entity stops being plain (gains a client): the client
+        needs create_entity ops and watcher counts for every current
+        neighbor, so the packed state must surface."""
+        if self.aoi_slot < 0 or self.space is None:
+            return
+        for other in self.space.derive_interests(self.aoi_slot):
+            self.interested_in.add(other)
+            other.interested_by.add(self)
+
+    def _dematerialize_interests(self):
+        """Inverse of _materialize_interests: the entity became plain again
+        (lost its client); its eager sets would go stale because future
+        events take the vectorized fast path, so drop them back into the
+        packed-only representation."""
+        if self.interested_in:
+            for other in self.interested_in:
+                other.interested_by.discard(self)
+            self.interested_in.clear()
 
     # -- client binding ----------------------------------------------------
     def drop_client_ref(self):
@@ -298,8 +362,12 @@ class Entity:
         for other in self.interested_in:
             other._watcher_clients -= 1
         self.client = None
+        self._recompute_plain()
+        if self._plain_aoi:
+            self._dematerialize_interests()
 
     def set_client(self, client: GameClient | None):
+        was_plain = self._plain_aoi
         old = self.client
         if old is not None:
             old.destroy_entity(self)
@@ -309,6 +377,10 @@ class Entity:
             self.client = None
             self.on_client_disconnected()
         if client is not None:
+            if was_plain:
+                # surface the packed interest state: the new client needs a
+                # create op and a watcher count per current neighbor
+                self._materialize_interests()
             for other in self.interested_in:
                 other._watcher_clients += 1
             # flush pending deltas to the old audiences first -- the
@@ -320,7 +392,12 @@ class Entity:
             client.create_entity(self, is_player=True)
             for other in self.interested_in:
                 client.create_entity(other, is_player=False)
+            self._recompute_plain()
             self.on_client_connected()
+        else:
+            self._recompute_plain()
+            if self._plain_aoi:
+                self._dematerialize_interests()
 
     def give_client_to(self, other: "Entity | str"):
         """Move client ownership to another entity -- local fast path, or

@@ -31,6 +31,10 @@ class EntityTypeDesc:
     use_aoi: bool
     aoi_distance: float
     rpc_descs: dict[str, RpcDesc]
+    # True when the type keeps the default (no-op) AOI hooks: event replay
+    # for clientless instances is then pure interest-set bookkeeping and
+    # rides the batched fast path (Space.dispatch_aoi_events)
+    plain_aoi_hooks: bool = True
 
 
 class EntityManager:
@@ -61,6 +65,10 @@ class EntityManager:
             use_aoi=bool(cls.use_aoi),
             aoi_distance=float(cls.aoi_distance),
             rpc_descs=collect_rpc_descs(cls),
+            plain_aoi_hooks=(
+                cls.on_enter_aoi is Entity.on_enter_aoi
+                and cls.on_leave_aoi is Entity.on_leave_aoi
+            ),
         )
         self.registry[type_name] = desc
         return desc
@@ -87,6 +95,8 @@ class EntityManager:
         e.type_name = type_name
         e.manager = self
         e.desc = desc
+        e._dirty_set = self.runtime._dirty_entities  # stable set object
+        e._plain_aoi = desc.plain_aoi_hooks
         if attrs:
             e.attrs.assign(attrs)
         e.on_init()
@@ -125,6 +135,7 @@ class EntityManager:
         cli = data.get("client")
         if cli is not None and client_factory is not None:
             e.client = client_factory(*cli)
+            e._recompute_plain()
         e.on_migrate_in()
         return e
 

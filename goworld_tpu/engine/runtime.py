@@ -36,13 +36,16 @@ class Runtime:
         aoi_backend: str = "cpu",
         now: Callable[[], float] = time.monotonic,
         on_error: Callable[[BaseException], None] | None = None,
+        aoi_mesh=None,
+        aoi_pipeline: bool = False,
     ):
         self.now = now
         self.on_error = on_error or self._default_on_error
         self.timers = TimerQueue(now)
         self.post = PostQueue()
         self.crontab = Crontab()
-        self.aoi = AOIEngine(default_backend=aoi_backend)
+        self.aoi = AOIEngine(default_backend=aoi_backend, mesh=aoi_mesh,
+                             pipeline=aoi_pipeline)
         self.entities = EntityManager(self)
         self.tick_count = 0
         # entities with pending sync flags / attr deltas / quiet countdowns;
@@ -77,32 +80,48 @@ class Runtime:
 
     def _aoi_phase(self):
         spaces = list(self.entities.spaces.values())
-        staged = [sp for sp in spaces if sp.submit_aoi()]
-        if staged:
+        staged = False
+        for sp in spaces:
+            # slots freed last tick become reusable now: with a pipelined
+            # calculator, events harvested this tick may still reference a
+            # slot freed last tick -- same-tick reuse would misattribute them
+            sp.recycle_aoi_slots()
+            staged = sp.submit_aoi() or staged
+        # a pipelined bucket may hold an inflight tick even when nothing new
+        # is staged (trailing flush); events can land on any AOI space, not
+        # just the ones staged this tick
+        if staged or self.aoi.has_pending():
             self.aoi.flush()
-            for sp in staged:
+            for sp in spaces:
                 sp.dispatch_aoi_events()
 
     def _sync_phase(self):
         """Collect position sync + flush attr deltas for DIRTY entities only
         (entities self-register via Entity._mark_dirty; idle entities cost
-        nothing per tick)."""
-        if not self._dirty_entities:
+        nothing per tick).  The dirty set object is STABLE -- entities cache
+        a reference to it (Entity._dirty_set) -- so it is drained in place,
+        never swapped.  The common steady-state case (no client, nobody's
+        client watching) exits after two integer tests."""
+        ds = self._dirty_entities
+        if not ds:
             return
-        dirty, self._dirty_entities = self._dirty_entities, set()
+        dirty = list(ds)
+        ds.clear()
         for e in dirty:
             if e.destroyed:
                 continue
-            if e._sync_flags:
-                self._collect_sync(e)
+            flags = e._sync_flags
+            if flags:
                 e._sync_flags = 0
+                if (e.client is not None or
+                        (flags & SYNC_NEIGHBORS and e._watcher_clients > 0)):
+                    self._collect_sync(e, flags)
             if e._attr_deltas:
                 e._flush_attr_deltas()
 
-    def _collect_sync(self, e: Entity):
+    def _collect_sync(self, e: Entity, flags: int):
         """One 16-byte-payload record per flagged entity per tick
         (reference record layout: proto.go:135-139)."""
-        flags = e._sync_flags
         x, y, z = e.position.to_tuple()
         if flags & SYNC_OWN and e.client is not None:
             self.sync_out.append(

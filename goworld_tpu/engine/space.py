@@ -46,7 +46,21 @@ class Space(Entity):
         self._r = np.empty(0, np.float32)
         self._act = np.empty(0, bool)
         self._slot_entity: list[Entity | None] = []
+        # numpy object-array mirror of _slot_entity: event replay fancy-
+        # indexes whole pair columns at C speed instead of per-pair list
+        # lookups (dispatch_aoi_events)
+        self._slot_np = np.empty(0, object)
+        # per-slot flag: observer needs eager event replay (has a client or
+        # overridden AOI hooks).  Pairs whose observer is PLAIN are dropped
+        # before the replay loop -- their interest state lives in the
+        # calculator's packed words and materializes on demand
+        # (derive_interests; Entity.neighbors)
+        self._nonplain = np.zeros(0, bool)
         self._free_slots: list[int] = []
+        # slots freed this tick; recycled at the NEXT tick's AOI phase so a
+        # pipelined calculator's one-tick-late events can never land on a
+        # reused slot (runtime.recycle_aoi_slots)
+        self._free_cooling: list[int] = []
         self._slot_watermark = 0
         self._aoi_dirty = False
 
@@ -98,6 +112,12 @@ class Space(Entity):
         act[: len(self._act)] = self._act
         self._act = act
         self._slot_entity.extend([None] * (new_cap - len(self._slot_entity)))
+        slot_np = np.empty(new_cap, object)
+        slot_np[: len(self._slot_np)] = self._slot_np
+        self._slot_np = slot_np
+        nonplain = np.zeros(new_cap, bool)
+        nonplain[: len(self._nonplain)] = self._nonplain
+        self._nonplain = nonplain
         old_cap = self._cap
         self._cap = new_cap
         if self._aoi_handle is not None and old_cap:
@@ -125,9 +145,11 @@ class Space(Entity):
                 slot = self._next_slot()
             e.aoi_slot = slot
             self._slot_entity[slot] = e
-            self._x[slot] = np.float32(pos.x)
-            self._z[slot] = np.float32(pos.z)
-            self._r[slot] = np.float32(
+            self._slot_np[slot] = e
+            self._nonplain[slot] = not e._plain_aoi
+            self._x[slot] = pos.x
+            self._z[slot] = pos.z
+            self._r[slot] = (
                 e.aoi_distance if e.aoi_distance > 0 else self._aoi_default_dist
             )
             self._act[slot] = True
@@ -151,7 +173,9 @@ class Space(Entity):
             slot = e.aoi_slot
             self._act[slot] = False
             self._slot_entity[slot] = None
-            self._free_slots.append(slot)
+            self._slot_np[slot] = None
+            self._nonplain[slot] = False
+            self._free_cooling.append(slot)
             e.aoi_slot = -1
             self._aoi_dirty = True
             # erase the slot from the calculator's previous-tick state: the
@@ -170,14 +194,21 @@ class Space(Entity):
         e.on_leave_space(self)
 
     def move_entity(self, e: Entity, pos: Vector3):
-        """Reference: Space.move, Space.go:253-261."""
+        """Reference: Space.move, Space.go:253-261.  (Entity.set_position
+        inlines this; other callers use it directly.)"""
         e.position = pos
         if e.aoi_slot >= 0:
-            self._x[e.aoi_slot] = np.float32(pos.x)
-            self._z[e.aoi_slot] = np.float32(pos.z)
+            self._x[e.aoi_slot] = pos.x
+            self._z[e.aoi_slot] = pos.z
             self._aoi_dirty = True
 
     # -- per-tick AOI ------------------------------------------------------
+    def recycle_aoi_slots(self):
+        """Release slots freed last tick for reuse (see ``_free_cooling``)."""
+        if self._free_cooling:
+            self._free_slots.extend(self._free_cooling)
+            self._free_cooling.clear()
+
     def submit_aoi(self) -> bool:
         """Stage this tick's arrays if anything changed; returns staged?"""
         if self._aoi_handle is None or not self._aoi_dirty:
@@ -189,22 +220,78 @@ class Space(Entity):
         return True
 
     def dispatch_aoi_events(self):
-        """Replay batched enter/leave pairs through entity interest hooks."""
+        """Replay batched enter/leave pairs through entity interest hooks.
+
+        Fast path: a pair whose OBSERVER has no client and default AOI hooks
+        (``_plain_aoi``) is pure interest-set bookkeeping -- two C-level set
+        ops, no method dispatch.  Observers with a client or overridden
+        hooks take the full ``_interest``/``_uninterest`` path (client
+        create/destroy ops, watcher counts, user callbacks).  Slot->entity
+        resolution fancy-indexes the object-array mirror: one C pass per
+        event batch instead of two list lookups per pair."""
         if self._aoi_handle is None:
             return
         enter, leave = self._runtime().aoi.take_events(self._aoi_handle)
+        se = self._slot_np
+        nonplain = self._nonplain
         # leaves first: a slot reused within one tick (leave+enter) must
-        # destroy before re-creating on clients
-        for i, j in leave:
-            a = self._slot_entity[i]
-            b = self._slot_entity[j]
-            if a is not None and b is not None:
-                a._uninterest(b)
-        for i, j in enter:
-            a = self._slot_entity[i]
-            b = self._slot_entity[j]
-            if a is not None and b is not None:
-                a._interest(b)
+        # destroy before re-creating on clients.  Pairs with a PLAIN
+        # observer are dropped wholesale (one vectorized mask): their
+        # interest state is the calculator's packed words, derived on
+        # demand -- no per-pair host work at all.
+        if len(leave):
+            need = leave[nonplain[leave[:, 0]]]
+            for a, b in zip(se[need[:, 0]], se[need[:, 1]]):
+                if a is not None and b is not None:
+                    a._uninterest(b)
+        if len(enter):
+            need = enter[nonplain[enter[:, 0]]]
+            for a, b in zip(se[need[:, 0]], se[need[:, 1]]):
+                if a is not None and b is not None:
+                    a._interest(b)
+
+    # -- lazy interest derivation ------------------------------------------
+    def derive_interests(self, slot: int) -> list[Entity]:
+        """Entities the slot's entity is interested in, derived from the
+        calculator's packed interest words (post-last-flush state).  This is
+        how PLAIN entities -- no client, default hooks -- answer
+        ``neighbors()`` without any per-event host bookkeeping: the
+        authoritative interest state never leaves the packed representation
+        until someone actually asks."""
+        h = self._aoi_handle
+        if h is None or slot < 0:
+            return []
+        words = h.bucket.peek_words(h.slot)
+        if words is None:
+            words = h.bucket.get_prev(h.slot)
+        row = words[slot]
+        w_per = row.shape[0]
+        sn = self._slot_np
+        out = []
+        for w in np.nonzero(row)[0]:
+            bits = int(row[w])
+            while bits:
+                k = (bits & -bits).bit_length() - 1
+                bits &= bits - 1
+                e = sn[k * w_per + w]  # planar layout: j = k*W + w
+                if e is not None:
+                    out.append(e)
+        return out
+
+    def derive_observers(self, slot: int) -> list[Entity]:
+        """Entities interested IN the slot's entity (the packed column)."""
+        h = self._aoi_handle
+        if h is None or slot < 0:
+            return []
+        words = h.bucket.peek_words(h.slot)
+        if words is None:
+            words = h.bucket.get_prev(h.slot)
+        from ..ops import aoi_predicate as AP
+
+        w, b = AP.word_bit_for_column(slot, self._cap)
+        rows = np.nonzero(words[:, w] & (np.uint32(1) << np.uint32(b)))[0]
+        sn = self._slot_np
+        return [sn[i] for i in rows if sn[i] is not None]
 
     # -- destroy -----------------------------------------------------------
     def _destroy_impl(self, is_migrate: bool):

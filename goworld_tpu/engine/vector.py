@@ -1,26 +1,35 @@
-"""float32 3-vector used for entity positions (reference:
-/root/reference/engine/entity/Vector3.go).  AOI operates on the X-Z plane."""
+"""3-vector used for entity positions (reference:
+/root/reference/engine/entity/Vector3.go).  AOI operates on the X-Z plane.
+
+Hot-path note: one Vector3 is constructed per set_position per entity per
+tick, so this is a plain ``__slots__`` class -- the earlier frozen-dataclass
+version (3 ``object.__setattr__`` + 3 float32 casts) cost ~1.2 us per
+construction and dominated the engine tick's host time.  Components are
+plain floats; float32 quantization happens where it matters bit-for-bit, at
+the AOI array boundary (Space's packed f32 arrays)."""
 
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass
-
-import numpy as np
-
-_f32 = np.float32
 
 
-@dataclass(frozen=True)
 class Vector3:
-    x: float = 0.0
-    y: float = 0.0
-    z: float = 0.0
+    __slots__ = ("x", "y", "z")
 
-    def __post_init__(self):
-        object.__setattr__(self, "x", float(_f32(self.x)))
-        object.__setattr__(self, "y", float(_f32(self.y)))
-        object.__setattr__(self, "z", float(_f32(self.z)))
+    def __init__(self, x: float = 0.0, y: float = 0.0, z: float = 0.0):
+        self.x = float(x)
+        self.y = float(y)
+        self.z = float(z)
+
+    def __repr__(self) -> str:
+        return f"Vector3({self.x}, {self.y}, {self.z})"
+
+    def __eq__(self, o) -> bool:
+        return (isinstance(o, Vector3) and self.x == o.x and self.y == o.y
+                and self.z == o.z)
+
+    def __hash__(self) -> int:
+        return hash((self.x, self.y, self.z))
 
     def distance_to(self, o: "Vector3") -> float:
         return math.sqrt(

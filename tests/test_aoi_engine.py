@@ -168,3 +168,86 @@ def test_tpu_cap_overflow_full_diff_recovery_parity():
         np.testing.assert_array_equal(evs["cpu"][1], evs["tpu"][1])
     # the recovery grew the per-chunk cap past the shrunken value
     assert tweaked[0]._kcap > 4
+
+
+def test_pipelined_flush_parity():
+    """pipeline=True delivers bit-identical events exactly ONE tick late:
+    flush T publishes tick T-1's events; a trailing flush (nothing staged)
+    drains the last tick."""
+    cap, n, ticks = 256, 180, 4
+    rng = np.random.default_rng(11)
+    sync = AOIEngine(default_backend="tpu")
+    pipe = AOIEngine(default_backend="tpu", pipeline=True)
+    hs = sync.create_space(cap)
+    hp = pipe.create_space(cap)
+    xs = rng.uniform(0, 600, n).astype(np.float32)
+    zs = rng.uniform(0, 600, n).astype(np.float32)
+    rr = rng.uniform(60, 120, n).astype(np.float32)
+    act = np.zeros(cap, bool)
+    act[:n] = True
+
+    def pad(a):
+        o = np.zeros(cap, a.dtype)
+        o[:n] = a
+        return o
+
+    sync_out, pipe_out = [], []
+    for _t in range(ticks):
+        xs += rng.uniform(-15, 15, n).astype(np.float32)
+        zs += rng.uniform(-15, 15, n).astype(np.float32)
+        for e, h in ((sync, hs), (pipe, hp)):
+            e.submit(h, pad(xs), pad(zs), pad(rr), act.copy())
+            e.flush()
+        sync_out.append(sync.take_events(hs))
+        pipe_out.append(pipe.take_events(hp))
+    # trailing flush delivers the final tick
+    assert pipe.has_pending()
+    pipe.flush()
+    pipe_out.append(pipe.take_events(hp))
+    assert not pipe.has_pending()
+
+    # tick 0 from the pipe is empty (nothing harvested yet)
+    assert len(pipe_out[0][0]) == 0 and len(pipe_out[0][1]) == 0
+    for t in range(ticks):
+        se, sl = sync_out[t]
+        pe, pl = pipe_out[t + 1]
+        np.testing.assert_array_equal(se, pe, err_msg=f"enter tick {t}")
+        np.testing.assert_array_equal(sl, pl, err_msg=f"leave tick {t}")
+
+
+def test_pipelined_grow_space_carries_pending_events():
+    """grow_space on a pipelined bucket must first drain the inflight tick
+    so its events survive the move to the larger bucket."""
+    cap, n = 128, 40
+    rng = np.random.default_rng(3)
+    eng = AOIEngine(default_backend="tpu", pipeline=True)
+    h = eng.create_space(cap)
+    xs = rng.uniform(0, 100, n).astype(np.float32)
+    rr = np.full(n, 50, np.float32)
+    act = np.ones(n, bool)
+    eng.submit(h, xs, xs, rr, act)
+    eng.flush()  # dispatched, not yet harvested
+    h2 = eng.grow_space(h, 256)  # must drain + carry the pending events
+    e, l = eng.take_events(h2)
+    assert len(e) > 0, "mass-enter events lost across pipelined growth"
+
+
+def test_pipelined_release_drops_stale_events():
+    """A slot released after its tick was dispatched (pipeline in flight)
+    must NOT receive that tick's events when reused -- the new space would
+    replay the dead space's pairs."""
+    eng = AOIEngine(default_backend="tpu", pipeline=True)
+    h1 = eng.create_space(128)
+    x = np.zeros(128, np.float32)
+    r = np.full(128, 10, np.float32)
+    act = np.zeros(128, bool)
+    act[:2] = True
+    eng.submit(h1, x, x, r, act)
+    eng.flush()  # dispatched, not yet harvested
+    eng.release_space(h1)
+    h2 = eng.create_space(128)
+    assert h2.slot == h1.slot
+    eng.submit(h2, x, x, r, np.zeros(128, bool))
+    eng.flush()  # harvests h1's inflight tick: must drop its events
+    e, l = eng.take_events(h2)
+    assert len(e) == 0 and len(l) == 0, "dead space's events leaked"

@@ -1,0 +1,176 @@
+"""Lazy interest sets: PLAIN entities (no client, default AOI hooks) keep
+their interest state in the calculator's packed words and derive it on
+demand; entities with clients/hooks keep eager sets.  The two views must
+agree at all times, across every backend, through client attach/detach
+(materialize/dematerialize) and freeze-style derivation."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.engine.entity import Entity, GameClient
+from goworld_tpu.engine.runtime import Runtime
+from goworld_tpu.engine.space import Space
+from goworld_tpu.engine.vector import Vector3
+
+
+class Scene(Space):
+    pass
+
+
+class Mob(Entity):  # plain: default hooks, no client
+    use_aoi = True
+    aoi_distance = 50.0
+
+
+class Watcher(Entity):  # non-plain: overridden hooks
+    use_aoi = True
+    aoi_distance = 50.0
+
+    def on_init(self):
+        self.seen = []
+
+    def on_enter_aoi(self, other):
+        self.seen.append(other.id)
+
+
+def build(backend):
+    rt = Runtime(aoi_backend=backend)
+    rt.entities.register(Scene)
+    rt.entities.register(Mob)
+    rt.entities.register(Watcher)
+    sp = rt.entities.create_space("Scene", kind=1)
+    sp.enable_aoi(50.0)
+    return rt, sp
+
+
+@pytest.mark.parametrize("backend", ["cpu", "cpp", "tpu"])
+def test_plain_neighbors_derive_from_packed_words(backend):
+    rt, sp = build(backend)
+    a = rt.entities.create("Mob", space=sp, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Mob", space=sp, pos=Vector3(10, 0, 10))
+    c = rt.entities.create("Mob", space=sp, pos=Vector3(500, 0, 500))
+    rt.tick()
+    # plain entities: eager sets stay EMPTY, neighbors() derives
+    assert a.interested_in == set() and a.interested_by == set()
+    assert set(a.neighbors()) == {b}
+    assert set(b.neighbors()) == {a}
+    assert set(c.neighbors()) == set()
+    assert set(a.observers()) == {b}
+    # movement updates the derived view
+    c.set_position(Vector3(20, 0, 20))
+    rt.tick()
+    assert set(a.neighbors()) == {b, c}
+    assert set(c.neighbors()) == {a, b}
+    # departure clears the packed state synchronously
+    b.destroy()
+    assert set(a.neighbors()) == {c}
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_client_attach_materializes_and_detach_dematerializes(backend):
+    rt, sp = build(backend)
+    a = rt.entities.create("Mob", space=sp, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Mob", space=sp, pos=Vector3(10, 0, 10))
+    rt.tick()
+    assert a.interested_in == set()
+
+    cli = GameClient("c1")
+    a.set_client(cli)
+    # materialized: eager sets now live, neighbor created on the client
+    assert a.interested_in == {b} and b.interested_by == {a}
+    assert b._watcher_clients == 1
+    creates = [op for op in cli.outbox if op[0] == "create_entity"]
+    assert {op[2] for op in creates} == {a.id, b.id}
+
+    # while clienty, replay is eager: c walks in -> create op + sets update
+    c = rt.entities.create("Mob", space=sp, pos=Vector3(5, 0, 5))
+    rt.tick()
+    assert c in a.interested_in and c._watcher_clients == 1
+    assert any(op[0] == "create_entity" and op[2] == c.id
+               for op in cli.outbox)
+
+    a.set_client(None)
+    # dematerialized: back to packed-only
+    assert a.interested_in == set()
+    assert b.interested_by == set() and b._watcher_clients == 0
+    assert set(a.neighbors()) == {b, c}
+
+    # subsequent moves keep the derived view correct with no eager state
+    c.set_position(Vector3(500, 0, 500))
+    rt.tick()
+    assert set(a.neighbors()) == {b}
+
+
+def test_mixed_plain_and_watcher_pairs():
+    rt, sp = build("cpu")
+    w = rt.entities.create("Watcher", space=sp, pos=Vector3(0, 0, 0))
+    m = rt.entities.create("Mob", space=sp, pos=Vector3(10, 0, 10))
+    rt.tick()
+    # watcher is eager (hook fired, sets maintained); mob derives
+    assert w.seen == [m.id]
+    assert w.interested_in == {m}
+    assert m.interested_by == {w}  # non-plain observers ARE tracked on m
+    assert m.interested_in == set()
+    assert set(m.neighbors()) == {w}
+    # mob leaving severs the watcher's eager state synchronously
+    m.destroy()
+    assert w.interested_in == set()
+
+
+def test_derived_matches_eager_under_churn():
+    """Drive identical scenarios with a plain type and a hooked type; the
+    plain side's derived neighbor sets must equal the hooked side's eager
+    sets every tick."""
+    rng = np.random.default_rng(4)
+    pos0 = rng.uniform(0, 200, (40, 2))
+    rts = {}
+    ents = {}
+    for kind, tname in (("plain", "Mob"), ("eager", "Watcher")):
+        rt, sp = build("cpu")
+        es = [rt.entities.create(tname, space=sp,
+                                 pos=Vector3(pos0[i, 0], 0, pos0[i, 1]))
+              for i in range(40)]
+        rts[kind] = rt
+        ents[kind] = es
+    rng = np.random.default_rng(9)
+    for _t in range(4):
+        moves = rng.uniform(-40, 40, (40, 2))
+        for kind in rts:
+            for e, d in zip(ents[kind], moves):
+                e.set_position(Vector3(e.position.x + d[0], 0,
+                                       e.position.z + d[1]))
+            rts[kind].tick()
+        for i in range(40):
+            derived = {ents["plain"].index(n) for n in
+                       ents["plain"][i].neighbors()}
+            eager = {ents["eager"].index(n) for n in
+                     ents["eager"][i].interested_in}
+            assert derived == eager, f"slot {i} diverged"
+
+
+def test_pipelined_mirror_survives_clear_ordering():
+    """A clear_entity issued while a tick is in flight postdates that tick's
+    change stream; the mirror must apply stream-then-clear, or the harvest
+    XOR re-plants the bits the clear removed (ghost interests forever)."""
+    from goworld_tpu.engine.aoi import AOIEngine
+
+    eng = AOIEngine(default_backend="tpu", pipeline=True)
+    h = eng.create_space(128)
+    x = np.array([0.0, 5.0], np.float32)
+    r = np.full(2, 50, np.float32)
+    act = np.ones(2, bool)
+    b = h.bucket
+    b.peek_words(h.slot)  # enable the mirror BEFORE any traffic
+    eng.submit(h, x, x, r, act)
+    eng.flush()  # enter pair dispatched, in flight
+    # entity 1 departs before the harvest
+    eng.clear_entity(h, 1)
+    act2 = act.copy()
+    act2[1] = False
+    eng.submit(h, x, x, r, act2)
+    eng.flush()  # harvests tick 1's stream, then the clear must re-apply
+    eng.flush()  # trailing harvest
+    words = b.peek_words(h.slot)
+    assert not words.any(), (
+        "ghost interest bits survived the in-flight clear: %r"
+        % words[words != 0])

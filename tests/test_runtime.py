@@ -94,13 +94,21 @@ def test_leave_space_and_slot_reuse_clean(backend):
     slot_b = b.aoi_slot
     scene.leave_entity(b)
     assert a.interested_in == set() and a.lost == [b.id]
-    # new entity reuses b's slot; must not inherit b's interest state
+    # freed slots COOL for one tick (a pipelined calculator's one-tick-late
+    # events must never land on a reused slot); same-tick entrants get a
+    # fresh slot
     d = rt.entities.create("Player", space=scene, pos=Vector3(1000, 0, 1000))
-    assert d.aoi_slot == slot_b
+    assert d.aoi_slot != slot_b
     rt.tick()
     assert d.seen == [] and a.seen == [b.id]  # no ghost enter/leave
     rt.tick()
     assert d.seen == [] and d.lost == []
+    # after the cooling tick the slot recycles -- and must start clean
+    e2 = rt.entities.create("Player", space=scene, pos=Vector3(2000, 0, 2000))
+    assert e2.aoi_slot == slot_b
+    rt.tick()
+    rt.tick()
+    assert e2.seen == [] and e2.lost == []
 
 
 def test_client_replication_and_sync():
