@@ -1,0 +1,203 @@
+"""Block-culled AOI kernel for large capacities.
+
+The dense kernel (ops/aoi_pallas) evaluates all C^2 pairs per space per
+tick -- 17G pair-tests at the BASELINE `million` config (64 x 16384).  This
+module is the windowed-work answer (the reference's XZList/TowerAOI idea,
+/root/reference/engine/entity/Space.go:105-115, rebuilt TPU-style):
+
+  1. per space, order entities by x (``argsort`` + gathers -- the order
+     only needs to make index-contiguous GROUPS spatially compact, not be
+     perfectly sorted, so nearly-sorted inputs work identically);
+  2. compute per row-block reach bounds ``[min(x-r), max(x+r)]`` and per
+     column-group position bounds ``[min x, max x]`` from the actual data;
+  3. a planewise Pallas kernel runs the same exact predicate + slice-pack
+     as the dense kernel, but each (row-block, column-group, bit-plane)
+     grid step first consults a precomputed SMEM cull flag and skips ALL
+     mask/pack compute for spatially disjoint blocks (``pl.when``) --
+     compute drops to the overlap fraction while outputs stay dense packed
+     words.
+
+Bounds are widened by an absolute f32-safety margin so the cull can only
+ever ADMIT extra blocks, never drop a true pair; every admitted pair is
+then re-checked by the exact f32 predicate, so the words are bit-identical
+to the dense kernel's (tests/test_aoi_grid.py proves it against both the
+dense kernel and the CPU oracle through the permutation).
+
+The words come out in SORTED index space together with the permutation;
+callers either translate sparse events through the permutation or, like
+bench.py's device-cadence pipeline, avoid the translation entirely by
+recomputing the previous tick's words under the CURRENT order (positions
+are a pure function input) and diffing in sorted space.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .aoi_predicate import WORD_BITS, words_per_row
+
+_INF = float("inf")
+
+
+def _mask_block(x_row, z_row, r_row, xc, zc, *, ti, col_off, bi):
+    """xc/zc are [1, cb] column slices (already loaded); rows come as refs."""
+    cb = xc.shape[-1]
+    xr = x_row[0, 0].reshape(ti, 1)
+    zr = z_row[0, 0].reshape(ti, 1)
+    rr = r_row[0, 0].reshape(ti, 1)
+    row_ids = bi * ti + jax.lax.broadcasted_iota(jnp.int32, (ti, 1), 0)
+    col_ids = col_off + jax.lax.broadcasted_iota(jnp.int32, (ti, cb), 1)
+    m = (jnp.abs(xc - xr) <= rr) & (jnp.abs(zc - zr) <= rr)
+    return m & (row_ids != col_ids)
+
+
+def _culled_kernel(need, x_row, z_row, r_row, x_col, z_col, out, *, ti, w,
+                   wb):
+    """Planewise slice-pack with whole-step SMEM culling.
+
+    Grid (S, C//ti, w//wb, 32): step (si, bi, wo, k) computes bit plane k
+    over words [wo*wb, (wo+1)*wb); the out block accumulates across the
+    innermost plane dim (k==0 initializes, so skipped revisits stay
+    sound), and the whole step's mask+pack is predicated on the SMEM cull
+    flag.  Structure notes from measurement on v5e: whole-step ``pl.when``
+    predication actually skips the work, whereas per-plane ``pl.when``
+    inside one step lowers to predicated full execution, and a dynamic
+    fori_loop over a packed plane list costs ~100 us/step in Mosaic
+    overheads -- both lose the cull's win.  The remaining per-step cost of
+    this 4-dim structure is amortized by large row blocks (block_rows).
+    """
+    bi = pl.program_id(1)
+    wo = pl.program_id(2)
+    k = pl.program_id(3)
+
+    @pl.when(k == 0)
+    def _init():
+        out[0] = jnp.zeros_like(out[0])
+
+    @pl.when(need[0, 0, wo, k] != 0)
+    def _compute():
+        off = k * w + wo * wb
+        xc = x_col[0, 0].reshape(1, wb)
+        zc = z_col[0, 0].reshape(1, wb)
+        m32 = _mask_block(
+            x_row, z_row, r_row, xc, zc, ti=ti, col_off=off, bi=bi,
+        ).astype(jnp.int32)
+        kbit = jax.lax.shift_left(jnp.int32(1), k)
+        partu = jax.lax.bitcast_convert_type(m32 * kbit, jnp.uint32)
+        out[0] = out[0] | partu
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "col_words", "interpret"))
+def aoi_words_culled(x, z, radius, active, *, block_rows=128, col_words=0,
+                     interpret=None):
+    """Packed interest words for the CURRENT positions, with block culling.
+
+    Args: x, z, radius [S, C] f32; active [S, C] bool -- in the CALLER's
+    index order, which should be spatially compact per 128-index group
+    (use :func:`sort_spaces` first).  Returns ``(words [S, C, W] u32,
+    culled_frac f32 scalar)`` where culled_frac is the fraction of grid
+    blocks skipped (the work saved; 0 on pathological layouts).
+
+    No prev/diff input: this computes absolute words.  Diffing strategies
+    are the caller's (see module docstring).  Bit-exact with
+    ``aoi_step_pallas(... prev=0)[0]`` on identical inputs.
+    """
+    s, c = x.shape
+    w = words_per_row(c)
+    ti = min(block_rows, c)
+    if ti != c:
+        ti = (ti // 128) * 128
+        if ti == 0 or c % ti != 0:
+            ti = c
+    wb = col_words or min(w, 512)
+    while w % wb:
+        wb //= 2
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and wb < 128:
+        # Mosaic lane rule: the column/out blocks ride the lane dim, so the
+        # word window must be >= 128 -- i.e. this kernel needs W >= 128
+        # (C >= 4096).  Below that the dense kernel is the right tool
+        # anyway (the whole space fits a handful of blocks).
+        raise ValueError(
+            f"aoi_words_culled needs col_words >= 128 on TPU (got wb={wb} "
+            f"at C={c}); use ops.aoi_pallas.aoi_step_pallas below C=4096")
+
+    x_eff = jnp.where(active, x, jnp.float32(_INF))
+    z_eff = jnp.where(active, z, jnp.float32(_INF))
+    r_eff = jnp.where(active, radius, jnp.float32(-1.0))
+
+    # ---- cull table (outside pallas; tiny) -------------------------------
+    n_bi = c // ti
+    n_wo = w // wb
+    # conservative f32 margin: bounds may round, the predicate is exact, so
+    # the window only needs to be a hair wider than any rounding error
+    margin = jnp.float32(1e-3) + jnp.float32(1e-5) * (
+        jnp.max(jnp.where(active, jnp.abs(x), 0.0)) + jnp.max(radius))
+    xr_blocks = x_eff.reshape(s, n_bi, ti)
+    rr_blocks = r_eff.reshape(s, n_bi, ti)
+    fin = jnp.isfinite(xr_blocks)
+    row_lo = jnp.min(jnp.where(fin, xr_blocks - rr_blocks, jnp.float32(_INF)),
+                     axis=2) - margin
+    row_hi = jnp.max(jnp.where(fin, xr_blocks + rr_blocks,
+                               jnp.float32(-_INF)), axis=2) + margin
+    # column group (wo, k) covers entities [k*w + wo*wb, k*w + (wo+1)*wb):
+    # reshape to [s, 32, n_wo, wb] puts k before wo
+    xc = x_eff.reshape(s, WORD_BITS, n_wo, wb)
+    finc = jnp.isfinite(xc)
+    col_lo = jnp.min(jnp.where(finc, xc, jnp.float32(_INF)), axis=3)
+    col_hi = jnp.max(jnp.where(finc, xc, jnp.float32(-_INF)), axis=3)
+    # need[si, bi, wo, k] = row/column x-reach overlap (empty blocks drop)
+    need = ((col_lo[:, None, :, :] <= row_hi[:, :, None, None])
+            & (col_hi[:, None, :, :] >= row_lo[:, :, None, None]))
+    need = jnp.swapaxes(need, 2, 3).astype(jnp.int32)  # -> [s, bi, wo, k]
+    culled_frac = 1.0 - jnp.mean(need.astype(jnp.float32))
+
+    x3 = x_eff.reshape(s, 1, c)
+    z3 = z_eff.reshape(s, 1, c)
+    r3 = r_eff.reshape(s, 1, c)
+    row_spec = pl.BlockSpec(
+        (1, 1, ti), lambda si, bi, wo, k: (si, 0, bi))
+    col_spec = pl.BlockSpec(
+        (1, 1, wb), lambda si, bi, wo, k: (si, 0, k * (w // wb) + wo))
+    out_spec = pl.BlockSpec(
+        (1, ti, wb), lambda si, bi, wo, k: (si, bi, wo))
+    # SMEM blocks must keep the LAST TWO dims whole (Mosaic: divisible by
+    # (8, 128) or equal to the array dims), so the block spans all of
+    # (n_wo, 32) and the kernel indexes (wo, k) dynamically
+    need_spec = pl.BlockSpec(
+        (1, 1, n_wo, WORD_BITS), lambda si, bi, wo, k: (si, bi, 0, 0),
+        memory_space=pltpu.SMEM)
+    words = pl.pallas_call(
+        functools.partial(_culled_kernel, ti=ti, w=w, wb=wb),
+        grid=(s, n_bi, n_wo, WORD_BITS),
+        in_specs=[need_spec, row_spec, row_spec, row_spec, col_spec,
+                  col_spec],
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((s, c, w), jnp.uint32),
+        interpret=interpret,
+    )(need, x3, z3, r3, x3, z3)
+    return words, culled_frac
+
+
+def sort_spaces(x, z, radius, active):
+    """Order each space's entities by x (inactive entries sink to the end
+    via the +inf fold).  Returns (xs, zs, rs, acts, perm) -- perm maps
+    sorted index -> original index.
+
+    NOTE: device-side argsort measured ~150 ms per [8, 16384] call on this
+    chip -- do NOT call this per tick.  Sort once (host-side is fine) to
+    establish a spatially compact slot order and let it go stale: the cull
+    bounds come from the actual per-block data, so a drifted order only
+    widens the windows, never breaks exactness
+    (tests/test_aoi_grid.py::test_nearly_sorted_order_still_exact)."""
+    x_eff = jnp.where(active, x, jnp.float32(_INF))
+    perm = jnp.argsort(x_eff, axis=1)
+    take = lambda a: jnp.take_along_axis(a, perm, axis=1)
+    return take(x), take(z), take(radius), take(active), perm

@@ -1,0 +1,116 @@
+"""Block-culled AOI kernel (ops/aoi_grid): bit-exactness vs the dense
+kernel in sorted space, vs the CPU oracle through the permutation, and the
+cull-never-drops-a-pair property across adversarial layouts.
+
+Shape note: on a real TPU the kernel requires W >= 128 (C >= 4096, Mosaic
+lane rule); under interpret mode (CPU) smaller shapes keep the suite fast.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax
+
+from goworld_tpu.ops import aoi_predicate as P
+from goworld_tpu.ops.aoi_grid import aoi_words_culled, sort_spaces
+from goworld_tpu.ops.aoi_oracle import CPUAOIOracle
+from goworld_tpu.ops.aoi_pallas import aoi_step_pallas
+
+ON_TPU = jax.default_backend() == "tpu"
+BIG_C = 4096 if ON_TPU else 1024
+CW = 128 if ON_TPU else 32
+
+
+def layouts(rng, s, c):
+    """(name, x, z, r, act) adversarial layouts."""
+    uni = rng.uniform(0, 3000, (s, c)).astype(np.float32)
+    uniz = rng.uniform(0, 3000, (s, c)).astype(np.float32)
+    var_r = rng.uniform(20, 160, (s, c)).astype(np.float32)
+    act = rng.random((s, c)) < 0.9
+    yield "uniform-var-radius", uni, uniz, var_r, act
+
+    # zipfian hotspot: 90% of entities in a tight cluster
+    hot = rng.random((s, c)) < 0.9
+    hx = np.where(hot, rng.uniform(1400, 1600, (s, c)),
+                  rng.uniform(0, 3000, (s, c))).astype(np.float32)
+    hz = np.where(hot, rng.uniform(1400, 1600, (s, c)),
+                  rng.uniform(0, 3000, (s, c))).astype(np.float32)
+    yield "hotspot", hx, hz, np.full((s, c), 100, np.float32), act
+
+    # boundary tie lattice: positions on a grid whose spacing equals the
+    # radius, so |dx| == r exactly for many pairs (<= must include them)
+    lat = (rng.integers(0, 20, (s, c)) * 50).astype(np.float32)
+    latz = (rng.integers(0, 20, (s, c)) * 50).astype(np.float32)
+    yield "tie-lattice", lat, latz, np.full((s, c), 50, np.float32), act
+
+    # r == 0 with coincident entities (still pairs), plus inactives
+    same = np.zeros((s, c), np.float32)
+    yield "r0-coincident", same, same, np.zeros((s, c), np.float32), act
+
+    yield "all-inactive", uni, uniz, var_r, np.zeros((s, c), bool)
+
+
+def test_culled_bitexact_vs_dense_sorted_space():
+    rng = np.random.default_rng(1)
+    s, c = 2, BIG_C
+    for name, x, z, r, act in layouts(rng, s, c):
+        xs, zs, rs, acts, _perm = sort_spaces(
+            jnp.asarray(x), jnp.asarray(z), jnp.asarray(r), jnp.asarray(act))
+        culled, frac = aoi_words_culled(xs, zs, rs, acts, col_words=CW)
+        prev0 = jnp.zeros((s, c, P.words_per_row(c)), jnp.uint32)
+        dense, _ = aoi_step_pallas(xs, zs, rs, acts, prev0, emit="chg")
+        np.testing.assert_array_equal(
+            np.asarray(culled), np.asarray(dense), err_msg=name)
+        assert 0.0 <= float(frac) <= 1.0
+
+
+def test_culled_matches_oracle_through_permutation():
+    """Unpack the sorted-space words, permute back to original indices, and
+    compare against the CPU oracle's boolean interest matrix."""
+    rng = np.random.default_rng(7)
+    s, c, n = 1, BIG_C, 230
+    x = np.zeros((s, c), np.float32)
+    z = np.zeros((s, c), np.float32)
+    x[0, :n] = rng.uniform(0, 800, n)
+    z[0, :n] = rng.uniform(0, 800, n)
+    r = np.full((s, c), 60, np.float32)
+    act = np.zeros((s, c), bool)
+    act[0, :n] = True
+    xs, zs, rs, acts, perm = sort_spaces(
+        jnp.asarray(x), jnp.asarray(z), jnp.asarray(r), jnp.asarray(act))
+    words, _ = aoi_words_culled(xs, zs, rs, acts, col_words=CW)
+    m_sorted = P.unpack_rows(np.asarray(words)[0], c)
+    p = np.asarray(perm)[0]
+    m_orig = np.zeros((c, c), bool)
+    m_orig[np.ix_(p, p)] = m_sorted  # sorted (a, b) -> original (p[a], p[b])
+    oracle = CPUAOIOracle(c, "sweep")
+    oracle.step(x[0], z[0], r[0], act[0])
+    np.testing.assert_array_equal(
+        m_orig, P.unpack_rows(oracle.prev_words, c))
+
+
+def test_nearly_sorted_order_still_exact():
+    """The cull uses per-block bounds computed from the data, so a stale
+    (nearly-sorted) order -- the recompute-old path sorts by the CURRENT
+    tick's x and replays the PREVIOUS tick's positions through it -- must
+    stay bit-exact, just with less culling."""
+    rng = np.random.default_rng(3)
+    s, c = 1, BIG_C
+    x = rng.uniform(0, 2000, (s, c)).astype(np.float32)
+    z = rng.uniform(0, 2000, (s, c)).astype(np.float32)
+    r = np.full((s, c), 80, np.float32)
+    act = np.ones((s, c), bool)
+    x2 = np.clip(x + rng.uniform(-5, 5, (s, c)), 0, 2000).astype(np.float32)
+    # order by the NEW positions, evaluate the OLD ones through it
+    perm = np.argsort(x2, axis=1)
+    take = lambda a: np.take_along_axis(a, perm, axis=1)
+    culled, frac = aoi_words_culled(
+        jnp.asarray(take(x)), jnp.asarray(take(z)), jnp.asarray(take(r)),
+        jnp.asarray(take(act)), col_words=CW)
+    prev0 = jnp.zeros((s, c, P.words_per_row(c)), jnp.uint32)
+    dense, _ = aoi_step_pallas(
+        jnp.asarray(take(x)), jnp.asarray(take(z)), jnp.asarray(take(r)),
+        jnp.asarray(take(act)), prev0, emit="chg")
+    np.testing.assert_array_equal(np.asarray(culled), np.asarray(dense))
+    assert float(frac) > 0.3  # nearly-sorted still culls most blocks

@@ -79,15 +79,23 @@ def test_cli_start_bots_reload_stop(rundir):
     r = cli(["status", "-d", run])
     assert r.returncode == 0 and r.stdout.count("RUNNING") == 4, r.stdout
 
-    # strict bots against the live cluster
+    # strict bots against the live cluster -- enough bots and time for the
+    # cross-bot AOI visibility oracle to assert real pairs (the soak keeps
+    # the 100x30s reference-CI scale behind GW_SOAK=1; this default-on run
+    # is the same gauntlet at small scale)
+    import re
+
     bots = subprocess.run(
         [sys.executable, os.path.join(REPO, "examples", "test_client.py"),
-         "--gate", f"127.0.0.1:{gate_port}", "-N", "8",
-         "--duration", "3", "--strict"],
-        cwd=REPO, env=_env(), capture_output=True, text=True, timeout=90,
+         "--gate", f"127.0.0.1:{gate_port}", "-N", "16",
+         "--duration", "8", "--strict"],
+        cwd=REPO, env=_env(), capture_output=True, text=True, timeout=120,
     )
     assert bots.returncode == 0, f"bots failed:\n{bots.stdout}\n{bots.stderr}"
-    assert "8/8 bots OK" in bots.stdout
+    assert "16/16 bots OK" in bots.stdout
+    m = re.search(r"visibility checks: (\d+)", bots.stdout)
+    assert m and int(m.group(1)) > 0, \
+        "visibility oracle never asserted anything:\n" + bots.stdout
 
     # hot reload with a client CONNECTED THROUGH IT: its avatar state must
     # survive the freeze/restore (this is what distinguishes reload from a

@@ -270,3 +270,37 @@ def test_one_shot_timer_does_not_leak_or_refire():
     rt.tick()
     assert calls == ["boom"]
     assert p.dump_timers() == []  # fired one-shot must not survive to migration
+
+
+def test_bulk_move_entities():
+    """Space.move_entities: vectorized array updates, in-place position
+    mutation, sync flags only for watched/clienty entities, and no owner
+    echo for client-driven ones (same rule as set_position)."""
+    import numpy as np
+
+    from goworld_tpu.engine.entity import SYNC_NEIGHBORS, SYNC_OWN
+
+    rt, scene = build("cpu")
+    a = rt.entities.create("Player", space=scene, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Player", space=scene, pos=Vector3(10, 0, 10))
+    c = rt.entities.create("Player", space=scene, pos=Vector3(20, 0, 20))
+    rt.tick()
+    a.set_client(GameClient("bulk_cli"))
+    b.set_client_syncing(True)
+    b.set_client(GameClient("bulk_cli_b"))
+    rt.tick()
+    slots = np.array([e.aoi_slot for e in (a, b, c)], np.int64)
+    scene.move_entities(slots, np.array([1.0, 11.0, 21.0], np.float32),
+                        np.array([2.0, 12.0, 22.0], np.float32))
+    assert (a.position.x, a.position.z) == (1.0, 2.0)
+    assert (c.position.x, c.position.z) == (21.0, 22.0)
+    assert scene._x[a.aoi_slot] == np.float32(1.0)
+    assert scene._aoi_dirty
+    # a: server-driven with client -> own + neighbors
+    assert a._sync_flags & SYNC_OWN and a._sync_flags & SYNC_NEIGHBORS
+    # b: client-driven -> no owner echo
+    assert b._sync_flags & SYNC_NEIGHBORS and not (b._sync_flags & SYNC_OWN)
+    rt.tick()
+    sync = rt.drain_sync()
+    eids = {rec[2] for rec in sync}
+    assert a.id in eids  # own-client record for a
