@@ -52,10 +52,14 @@ def connect_mysql(host: str, port: int, user: str, password: str,
             )
             conn.autocommit = True
             return conn
-        except ImportError as e:
-            raise RuntimeError(
-                "the mysql backend requires pymysql or mysql-connector"
-            ) from e
+        except ImportError:
+            # no external driver: the in-repo wire driver (real MySQL
+            # protocol -- mysql_native_password deployments and the
+            # hermetic MiniMySQLServer; see ext/db/mysqlwire)
+            from .mysqlwire import MySQLWireClient
+
+            return MySQLWireClient(host=host, port=port, user=user,
+                                   password=password, database=database)
 
 
 def backend_config_kwargs(cls, cfg, base_dir: str = ".") -> dict:

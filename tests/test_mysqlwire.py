@@ -1,0 +1,82 @@
+"""Wire-level MySQL: packet framing, handshake + native-password auth,
+COM_QUERY text protocol, and the storage/kvdb mysql backends running their
+REAL network path over a socket (no injected DB-API shim) -- the hermetic
+equivalent of the reference CI's live-mysqld backend tests
+(/root/reference/.travis.yml:27-35)."""
+
+import pytest
+
+from goworld_tpu.ext.db.mysqlwire import (
+    MiniMySQLServer,
+    MySQLWireClient,
+    MySQLWireError,
+    escape_literal,
+)
+from test_db_backends import _exercise_entity_storage, _exercise_kvdb
+
+
+@pytest.fixture()
+def server():
+    srv = MiniMySQLServer()
+    yield srv
+    srv.close()
+
+
+def test_escape_literal_is_dual_dialect():
+    assert escape_literal(None) == "NULL"
+    assert escape_literal(7) == "7"
+    assert escape_literal(True) == "1"
+    assert escape_literal("it's") == "'it''s'"
+    assert escape_literal(b"\x00\xff'") == "x'00ff27'"
+    with pytest.raises(MySQLWireError):
+        escape_literal(object())
+
+
+def test_wire_client_query_roundtrip(server):
+    c = MySQLWireClient(port=server.port)
+    assert c.server_version.startswith("8.0")
+    cur = c.cursor()
+    cur.execute("CREATE TABLE IF NOT EXISTS t "
+                "(k VARCHAR(32) PRIMARY KEY, v BLOB, n TEXT)")
+    cur.execute("REPLACE INTO t (k, v, n) VALUES (%s, %s, %s)",
+                ("key'1", b"\x00\x01binary", None))
+    cur.execute("SELECT k, v, n FROM t WHERE k = %s", ("key'1",))
+    row = cur.fetchone()
+    assert row == ("key'1", b"\x00\x01binary", None)
+    assert cur.fetchone() is None
+    # type mapping: BLOB columns decode to bytes, text to str
+    assert isinstance(row[0], str) and isinstance(row[1], bytes)
+    cur.execute("SELECT 1 FROM t WHERE k = %s", ("missing",))
+    assert cur.fetchone() is None
+    with pytest.raises(MySQLWireError, match="query failed"):
+        cur.execute("SELECT syntax error from from")
+    # the connection survives a failed query
+    cur.execute("SELECT k FROM t")
+    assert cur.fetchall() == [("key'1",)]
+    c.close()
+
+
+def test_mysql_entity_storage_over_wire(server):
+    from goworld_tpu.storage.backends import MySQLEntityStorage
+
+    _exercise_entity_storage(MySQLEntityStorage(port=server.port))
+
+
+def test_mysql_kvdb_over_wire(server):
+    from goworld_tpu.kvdb.backends import MySQLKVDB
+
+    _exercise_kvdb(MySQLKVDB(port=server.port))
+
+
+def test_storage_service_against_wire_mysql(server):
+    from goworld_tpu.storage.backends import MySQLEntityStorage
+    from goworld_tpu.storage.service import EntityStorageService
+
+    svc = EntityStorageService(MySQLEntityStorage(port=server.port))
+    done = []
+    svc.save("Avatar", "e1", {"hp": 10, "inv": [1, "x"]},
+             callback=lambda: done.append("saved"))
+    svc.load("Avatar", "e1", callback=lambda data: done.append(data))
+    assert svc.wait_idle(5.0)
+    svc.close()
+    assert done == ["saved", {"hp": 10, "inv": [1, "x"]}]
