@@ -194,22 +194,43 @@ class AOIEngine:
             # tick forever.  A *silent* cpu fallback (plugin simply absent)
             # passes this probe but runs the kernel interpreted -- warn
             # loudly; that is right for hermetic tests and wrong for prod.
+            #
+            # The probe targets the engine's ACTUAL compute platform.  With a
+            # mesh, every byte of engine compute runs on the mesh's devices
+            # -- probing the default backend there once turned a hermetic CPU
+            # dryrun red when an unrelated rolling libtpu upgrade broke a TPU
+            # the engine never touches (round-3 MULTICHIP artifact).
             import jax
-            import jax.numpy as jnp
 
-            jnp.zeros(8).block_until_ready()
-            if jax.default_backend() != "tpu":
-                # EXACTLY the kernel's interpret condition
-                # (aoi_pallas: backend != "tpu" -> interpret mode), so any
-                # interpreted fallback is loud
-                from ..utils import gwlog
+            if self.mesh is not None:
+                dev = next(iter(self.mesh.mesh.devices.flat))
+                jax.device_put(np.zeros(8, np.float32),
+                               dev).block_until_ready()
+                if self.mesh.platform != "tpu":
+                    from ..utils import gwlog
 
-                gwlog.logger("gw.aoi").warning(
-                    "aoi_backend=tpu but jax default backend is %r -- the "
-                    "kernel will run in interpret mode (fine for tests, "
-                    "orders of magnitude too slow for production)",
-                    jax.default_backend(),
-                )
+                    gwlog.logger("gw.aoi").warning(
+                        "aoi_backend=tpu on a %r mesh -- the kernel will run "
+                        "in interpret mode (fine for tests/dryruns, orders "
+                        "of magnitude too slow for production)",
+                        self.mesh.platform,
+                    )
+            else:
+                import jax.numpy as jnp
+
+                jnp.zeros(8).block_until_ready()
+                if jax.default_backend() != "tpu":
+                    # EXACTLY the kernel's interpret condition
+                    # (aoi_pallas: backend != "tpu" -> interpret mode), so
+                    # any interpreted fallback is loud
+                    from ..utils import gwlog
+
+                    gwlog.logger("gw.aoi").warning(
+                        "aoi_backend=tpu but jax default backend is %r -- "
+                        "the kernel will run in interpret mode (fine for "
+                        "tests, orders of magnitude too slow for production)",
+                        jax.default_backend(),
+                    )
 
     def create_space(self, capacity: int, backend: str | None = None) -> SpaceAOIHandle:
         backend = backend or self.default_backend
