@@ -244,13 +244,37 @@ class MongoWireClient:
     def __getitem__(self, db: str) -> _WireDatabase:
         return _WireDatabase(self, db)
 
+    # commands a transparent retry cannot double-apply.  getMore is read-only
+    # but its server-side cursor dies with the connection, so retrying it is
+    # pointless; writes (insert/update/delete) whose reply was lost mid-read
+    # may already have applied -- re-sending could double-apply or surface a
+    # spurious DuplicateKeyError, so their retries belong to the storage
+    # service's loop, which owns the operation's idempotency story.
+    _RETRYABLE = frozenset({"find", "count", "hello", "ping", "ismaster"})
+
     def _command(self, db: str, cmd: dict) -> dict:
         doc = dict(cmd)
         doc["$db"] = db
         with self._lock:
+            if self._sock is None:
+                # a previous command died mid-flight and closed the socket;
+                # nothing is in flight NOW, so reconnecting before the send
+                # is safe for every command -- this is how a caller's retry
+                # of a non-retryable write actually reaches the server again
+                self._connect()
             try:
                 reply = self._roundtrip(doc)
             except (ConnectionError, OSError):
+                # the socket is dead either way: close it before any
+                # reconnect replaces it (fd leak otherwise)
+                if self._sock is not None:
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                    self._sock = None
+                if next(iter(cmd)) not in self._RETRYABLE:
+                    raise
                 # one transparent reconnect (the storage service's retry
                 # loop handles longer outages)
                 self._connect()

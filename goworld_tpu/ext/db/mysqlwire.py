@@ -17,8 +17,11 @@ image.  Same treatment as ext/db/mongowire, at the MySQL wire level:
 
 Parameters are interpolated client-side using ONLY constructs valid in
 both real MySQL and sqlite: ``''`` doubling for strings, ``x'..'`` hex
-literals for bytes, bare numbers, NULL.  No backslash escapes, so the
-hermetic server's sqlite parser and a real mysqld agree byte-for-byte.
+literals for bytes, bare numbers, NULL.  MySQL's default sql_mode treats
+backslash as an escape inside string literals (sqlite does not), so the
+client pins ``NO_BACKSLASH_ESCAPES`` -- see __init__ -- at connect; after
+that the hermetic server's sqlite parser and a real mysqld agree
+byte-for-byte, including for parameters containing backslashes.
 
 Column values decode as bytes for binary-charset BLOB columns and str
 otherwise -- exactly the two shapes the backends consume (msgpack blobs
@@ -197,6 +200,15 @@ class MySQLWireClient:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._handshake(user, password, database)
         self.autocommit = True  # text-protocol autocommit is server default
+        # Backslashes are escape characters under MySQL's default sql_mode
+        # but literal under sqlite; ''-doubled literals would therefore
+        # parse differently (a param ending in \ even breaks the quoting).
+        # NO_BACKSLASH_ESCAPES aligns a real mysqld with sqlite so one byte
+        # stream means the same thing in both; the hermetic server answers
+        # SET with a plain OK.
+        self._query(
+            "SET SESSION sql_mode = CONCAT(@@sql_mode, "
+            "',NO_BACKSLASH_ESCAPES')")
 
     # -- connection setup --------------------------------------------------
     def _handshake(self, user: str, password: str, database: str) -> None:
@@ -355,6 +367,12 @@ class _Handler(socketserver.BaseRequestHandler):
                                                 f"unsupported command {cmd}"))
                 continue
             sql = pkt[1:].decode("utf-8")
+            if sql.lstrip()[:4].upper() == "SET ":
+                # session knobs (sql_mode etc.) have no sqlite analog; the
+                # semantics they pin (NO_BACKSLASH_ESCAPES) are already how
+                # sqlite parses, so OK is the honest reply
+                _send_packet(sock, 1, self._ok())
+                continue
             try:
                 with lock:
                     cur = db.cursor()

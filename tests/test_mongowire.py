@@ -101,6 +101,15 @@ def test_wire_client_reconnects(server):
     # reconnect (the server store survives -- it is per-server, not per-conn)
     c._sock.close()
     assert col.find_one({"_id": "x"})["v"] == 1
+    # writes do NOT transparently retry: an insert whose reply was lost may
+    # already have applied, so re-sending could double-apply.  The error
+    # surfaces to the caller (whose retry loop owns write idempotency), and
+    # the NEXT call reconnects eagerly -- nothing is in flight then.
+    c._sock.close()
+    with pytest.raises((ConnectionError, OSError)):
+        col.insert_one({"_id": "y", "v": 2})
+    col.insert_one({"_id": "y", "v": 2})
+    assert col.find_one({"_id": "y"})["v"] == 2
     c.close()
 
 

@@ -53,6 +53,14 @@ def test_wire_client_query_roundtrip(server):
     # the connection survives a failed query
     cur.execute("SELECT k FROM t")
     assert cur.fetchall() == [("key'1",)]
+    # backslashes: literal under the NO_BACKSLASH_ESCAPES mode the client
+    # pins at connect (MySQL's default mode would treat the trailing \ as
+    # escaping the closing quote -- malformed statement / injection risk)
+    for evil in ("trailing\\", "a\\'b", "c:\\dir\\n"):
+        cur.execute("REPLACE INTO t (k, v, n) VALUES (%s, %s, %s)",
+                    (evil, b"x", evil))
+        cur.execute("SELECT k, n FROM t WHERE k = %s", (evil,))
+        assert cur.fetchone() == (evil, evil)
     c.close()
 
 
