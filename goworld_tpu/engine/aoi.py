@@ -30,6 +30,7 @@ tests/test_aoi_native.py).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import numpy as np
@@ -176,15 +177,9 @@ class AOIEngine:
             mesh = SpaceMesh(multichip_devices(mesh))
         self.mesh = mesh
         # double-buffered tpu flush: events arrive one tick late, D2H
-        # overlaps the host tick (SURVEY §7(d); see _TPUBucket docstring)
+        # overlaps the host tick (SURVEY §7(d); see _TPUBucket docstring --
+        # the mesh bucket implements the same contract per chip)
         self.pipeline = pipeline
-        if pipeline and mesh is not None:
-            from ..utils import gwlog
-
-            gwlog.logger("gw.aoi").warning(
-                "aoi_pipeline is not implemented for mesh buckets yet -- "
-                "mesh flushes run synchronously (events same-tick)"
-            )
         self._buckets: dict[tuple[str, int], _Bucket] = {}
         if default_backend == "tpu":
             # fail FAST at process boot, not on the first space's first
@@ -263,7 +258,8 @@ class AOIEngine:
                 if self.mesh is not None:
                     from .aoi_mesh import _MeshTPUBucket
 
-                    bucket = _MeshTPUBucket(capacity, self.mesh)
+                    bucket = _MeshTPUBucket(capacity, self.mesh,
+                                            pipeline=self.pipeline)
                 else:
                     bucket = _TPUBucket(capacity, pipeline=self.pipeline)
             else:
@@ -411,6 +407,9 @@ class _CPUBucket(_Bucket):
         self.algorithm = algorithm
         self._oracle_cls = oracle_cls
         self._oracles: list = []
+        # phase-attribution counters (seconds, cumulative; bench_engine
+        # reads deltas) -- a perf_counter pair per flush, noise-level cost
+        self.perf = {"calc_s": 0.0}
 
     def _grow_to(self, n_slots: int) -> None:
         while len(self._oracles) < n_slots:
@@ -422,9 +421,11 @@ class _CPUBucket(_Bucket):
         self._oracles[slot].reset()
 
     def flush(self) -> None:
+        t0 = time.perf_counter()
         for slot, (x, z, r, act) in self._staged.items():
             self._events[slot] = self._oracles[slot].step(x, z, r, act)
         self._staged.clear()
+        self.perf["calc_s"] += time.perf_counter() - t0
 
     def peek_words(self, slot: int) -> np.ndarray:
         return self._oracles[slot].prev_words
@@ -489,12 +490,19 @@ class _TPUBucket(_Bucket):
         # host-side from the full diff and the caps grow for the next tick.
         # A sliding peak window decays them again, so a one-off mass tick
         # (space fill, restore storm) doesn't pessimize every later flush.
+        # The window starts SHORT and doubles after each check: the common
+        # storm is the mass-enter at space fill, and a 128-flush window
+        # left the engine dragging a 131072-chunk extraction grid (and its
+        # ~100 MB scratch) for hundreds of ordinary ~600-chunk ticks.
         self._max_chunks = 4096
         self._kcap = 8
         self._peak_nd = 0
         self._peak_mcc = 0
-        self._refit_at = 128  # flushes until the next decay check
+        self._refit_at = 8  # flushes until the next decay check (doubles)
         self._flushes = 0
+        # True once a decay check has run and found the caps already fit --
+        # i.e. no recompile is pending; benchmarks warm up until here
+        self._steady = False
         # donated scratch buffers, keyed (s_n, mc, kcap); replaced by each
         # flush's returns (same device memory, in-place)
         self._scratch: dict[tuple, tuple] = {}
@@ -512,6 +520,12 @@ class _TPUBucket(_Bucket):
         # device-resident copies of rarely-changing staged arrays, keyed by
         # array role; re-uploaded only when the host values change
         self._h2d_cache: dict[str, tuple] = {}
+        # phase-attribution counters (seconds, cumulative): stage = host
+        # pack + H2D enqueue + dispatch, fetch = synchronous D2H waits,
+        # decode = stream decode + event expansion.  bench_engine reads
+        # deltas to attribute engine ms/tick between host logic, wire, and
+        # decode -- two perf_counter pairs per flush, noise-level cost.
+        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
 
     def _grow_to(self, n_slots: int) -> None:
         jnp = self._jnp
@@ -532,7 +546,12 @@ class _TPUBucket(_Bucket):
 
     def _reset_slot(self, slot: int) -> None:
         self._pending_reset.add(slot)
-        self._mirror_apply(("reset", slot))
+        if self._mirror is not None:
+            # immediate even with a tick in flight: the harvest XOR is
+            # epoch-guarded, so a dead epoch's stream can no longer re-plant
+            # bits over this reset, and derivations between now and the next
+            # flush must already see the slot empty
+            self._mirror_apply_now(("reset", slot))
 
     def peek_words(self, slot: int) -> np.ndarray:
         """Host mirror of the slot's interest words.  First call seeds the
@@ -541,13 +560,16 @@ class _TPUBucket(_Bucket):
         current with a vectorized XOR of the decoded change stream."""
         if self._mirror is None:
             self.drain()
-            # ascontiguousarray matters: a fetched device array can carry
-            # the TPU's tiled strides, and a non-C-contiguous mirror would
-            # make the harvest's reshape-XOR write to a silent copy
+            # explicit copy=True + order="C" are BOTH load-bearing: a fetched
+            # device array can carry the TPU's tiled strides (a non-C mirror
+            # would make the harvest's reshape-XOR write to a silent copy),
+            # and on the cpu backend np.asarray is a zero-copy READ-ONLY
+            # view (the XOR would raise)
             self._mirror = (np.zeros((self.s_max, self.capacity, self.W),
                                      np.uint32)
                             if self.prev is None
-                            else np.ascontiguousarray(np.asarray(self.prev)))
+                            else np.array(self.prev, np.uint32, copy=True,
+                                          order="C"))
         return self._mirror[slot]
 
     def flush(self) -> None:
@@ -600,6 +622,7 @@ class _TPUBucket(_Bucket):
                 self._harvest()
             return
 
+        t_stage0 = time.perf_counter()
         slots = sorted(self._staged)
         s_n = len(slots)
         x = np.zeros((s_n, c), np.float32)
@@ -669,6 +692,7 @@ class _TPUBucket(_Bucket):
                 a.copy_to_host_async()
             rec["prefetch"] = (ndp, escp, excp, slices)
         prev_rec, self._inflight = self._inflight, rec
+        self.perf["stage_s"] += time.perf_counter() - t_stage0
         if self.pipeline:
             if prev_rec is not None:
                 self._harvest(prev_rec)
@@ -697,6 +721,7 @@ class _TPUBucket(_Bucket):
         # pays a round trip when the chip is reached over a network tunnel);
         # under the pipeline it was issued async at dispatch and is local by
         # now
+        t_f0 = time.perf_counter()
         nd, mcc, base_row, n_esc, exc_n = (int(v) for v in
                                            np.asarray(rec["scalars"]))
         self._peak_nd = max(self._peak_nd, nd)
@@ -711,19 +736,31 @@ class _TPUBucket(_Bucket):
             if fit_nd < self._max_chunks or fit_k < self._kcap:
                 self._max_chunks = min(self._max_chunks, fit_nd)
                 self._kcap = min(self._kcap, fit_k)
+                self._steady = False  # one more clean window confirms
+            else:
+                self._steady = True
             self._peak_nd = self._peak_mcc = 0
             self._flushes = 0
+            self._refit_at = min(self._refit_at * 2, 128)
         if nd > mc or mcc > kcap:
             # caps exceeded: recover this tick from the full diff, then grow
             # the caps so the next tick extracts on device again
             self._max_chunks = max(self._max_chunks, 2 * nd)
             # a chunk holds at most _LANES nonzero words
             self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
+            # the storm that grew the caps must not anchor the next decay
+            # window's peak, or the post-storm shrink waits a full window
+            # with storm-sized extraction grids (and their scratch)
+            self._peak_nd = self._peak_mcc = 0
+            self._flushes = 0
+            self._refit_at = 8
+            self._steady = False
             chg_h = np.asarray(chg).reshape(-1)
             new_h = np.asarray(new).reshape(-1)
             gidx = np.nonzero(chg_h)[0]
             chg_vals = chg_h[gidx]
             ent_vals = chg_vals & new_h[gidx]
+            self.perf["fetch_s"] += time.perf_counter() - t_f0
         elif n_esc > self._max_gaps or exc_n > self._max_exc:
             # encode overflow (pathological churn): rebuild from the raw
             # grids kept on device
@@ -736,6 +773,7 @@ class _TPUBucket(_Bucket):
             chg_vals = vh[valid]
             ent_vals = chg_vals & nh[valid]
             gidx = (ch[:, None].astype(np.int64) * _LANES + lh)[valid]
+            self.perf["fetch_s"] += time.perf_counter() - t_f0
         else:
             # the common path fetches the ENCODED stream: ~5 B per dirty
             # chunk + 12 B per exception, overlapped slice transfers
@@ -753,9 +791,13 @@ class _TPUBucket(_Bucket):
                 for a in slices:
                     a.copy_to_host_async()
                 hb = [np.asarray(a) for a in slices]
+            self.perf["fetch_s"] += time.perf_counter() - t_f0
+            t_f0 = time.perf_counter()
             chg_vals, ent_vals, gidx = EV.decode_row_stream(
                 hb[0], hb[1], hb[2].astype(np.uint16), base_row, nd,
                 _LANES, hb[3], hb[4], hb[5], hb[6])
+            self.perf["decode_s"] += time.perf_counter() - t_f0
+        t_f0 = time.perf_counter()
         # refit the next dispatch's optimistic prefetch to this tick
         self._pred = (
             max(512, -(-nd * 5 // 4 // 128) * 128),
@@ -765,20 +807,34 @@ class _TPUBucket(_Bucket):
         if self._mirror is not None:
             if len(gidx):
                 # stream entries are whole words with unique indices, so one
-                # fancy-index XOR applies the tick exactly
+                # fancy-index XOR applies the tick exactly.  Rows whose slot
+                # was released since this tick's dispatch are skipped -- the
+                # same epoch guard that drops the dead space's events; a
+                # reused slot's mirror was already reset at re-acquire and
+                # must not have the dead stream XORed back in.
                 wps = c * self.W
                 gidx = np.asarray(gidx, np.int64)
-                srows = np.asarray(slots, np.int64)[gidx // wps]
-                self._mirror.reshape(self.s_max, wps)[srows, gidx % wps] ^= \
-                    chg_vals
+                rows = gidx // wps
+                cur = np.fromiter(
+                    (self._slot_epoch.get(s, 0) for s in slots),
+                    np.int64, len(slots))
+                keep = cur[rows] == np.asarray(rec["epochs"], np.int64)[rows]
+                g, v = (gidx, chg_vals) if keep.all() else (gidx[keep],
+                                                           chg_vals[keep])
+                srows = np.asarray(slots, np.int64)[g // wps]
+                self._mirror.reshape(self.s_max, wps)[srows, g % wps] ^= v
             if self._mirror_ops:
-                # clears/resets issued after this tick's dispatch apply now,
-                # AFTER its stream (see _mirror_apply).  Applied directly:
-                # the NEXT tick may already be in flight, and re-deferring
-                # would postpone them forever.
+                # clears issued after this tick's dispatch apply now, AFTER
+                # its stream (see _mirror_apply).  Applied directly: the
+                # NEXT tick may already be in flight, and re-deferring would
+                # postpone them forever.  The epoch tag drops ops whose slot
+                # was released since queueing -- a reacquired slot may carry
+                # freshly seeded words (set_prev) the dead occupant's clear
+                # must not touch.
                 ops, self._mirror_ops = self._mirror_ops, []
                 for op in ops:
-                    self._mirror_apply_now(op)
+                    if self._slot_epoch.get(op[1], 0) == op[-1]:
+                        self._mirror_apply_now(op[:-1])
         # the harvested scratch set returns to the pool for reuse
         self._scratch.setdefault(rec["key"], rec["scratch"])
         pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx, c, s_n)
@@ -793,6 +849,7 @@ class _TPUBucket(_Bucket):
             e = ent_rows.get(row, empty)
             l = lv_rows.get(row, empty)
             self._events[slot] = (e, l)
+        self.perf["decode_s"] += time.perf_counter() - t_f0
 
     def release_slot(self, slot: int) -> None:
         self._slot_epoch[slot] = self._slot_epoch.get(slot, 0) + 1
@@ -804,13 +861,14 @@ class _TPUBucket(_Bucket):
 
     def _mirror_apply(self, op: tuple) -> None:
         """Apply (or defer) one mirror maintenance op.  With a tick in
-        flight the op postdates that tick's stream, so it queues and runs
-        after the harvest XOR; otherwise it applies immediately so
-        derivations before the next flush already see it."""
+        flight the op postdates that tick's stream, so it queues (tagged
+        with the slot's current epoch) and runs after the harvest XOR;
+        otherwise it applies immediately so derivations before the next
+        flush already see it."""
         if self._mirror is None:
             return
         if self._inflight is not None:
-            self._mirror_ops.append(op)
+            self._mirror_ops.append(op + (self._slot_epoch.get(op[1], 0),))
             return
         self._mirror_apply_now(op)
 

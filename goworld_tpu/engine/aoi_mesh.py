@@ -21,6 +21,15 @@ per-chip streams with the same overflow contract as the single-chip bucket
 cap is exceeded.  Event pairs are bit-identical to every other backend
 (tests/test_aoi_mesh.py drives this against the CPU oracle).
 
+``pipeline=True`` double-buffers the flush exactly like the single-chip
+bucket (SURVEY §7 hard part (d)): ``flush()`` dispatches tick T and then
+harvests tick T-1, whose scalars + optimistically sized stream slices were
+issued ``copy_to_host_async`` at T-1's dispatch -- the D2H rides under the
+whole host tick between flushes and events arrive ONE TICK LATE.  Slot
+release epochs drop a dead space's in-flight events and mirror traffic; all
+large outputs ride DONATED per-capacity scratch buffers (two sets alternate
+naturally with the one-deep pipeline).
+
 Differences from the single-chip bucket (deliberate):
 
   * ALL slots step every flush (no ``slot_idx`` gather): a gather across the
@@ -37,11 +46,17 @@ Differences from the single-chip bucket (deliberate):
     callers guarantee this (growth and restore both mark the space AOI-dirty
     the same tick); ``flush`` raises if the contract is broken rather than
     corrupt interest state.
-  * Reset/clear maintenance rides a host round-trip of the interest words
-    (simple and exact); the hot per-tick path is the single fused dispatch.
+
+Maintenance never round-trips the full interest state: resets and clears
+scatter on device in ONE dispatch (donated, sharding pinned), ``set_prev``
+ships one slot's [C, W] words, ``get_prev`` fetches one slot's.  The only
+full-array host copy left is capacity growth (rare, amortized by doubling);
+``full_roundtrips`` counts it so tests can pin the steady state to zero.
 """
 
 from __future__ import annotations
+
+import time
 
 import numpy as np
 
@@ -56,12 +71,13 @@ class _MeshTPUBucket(_Bucket):
     """Device-mesh-resident interest state [S, C, W], spaces sharded over
     the mesh's 'space' axis; one fused shard_map dispatch per flush."""
 
-    def __init__(self, capacity: int, mesh):
+    def __init__(self, capacity: int, mesh, pipeline: bool = False):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
 
         self.mesh = mesh  # parallel.SpaceMesh
         self.n_dev = mesh.n_devices
+        self.pipeline = pipeline
         self.s_max = 0
         self.prev = None  # [S, C, W] uint32, sharded over axis 0
         # host-side staged inputs, persistent: unstaged slots re-submit their
@@ -81,15 +97,39 @@ class _MeshTPUBucket(_Bucket):
         self._max_gaps = 2048
         self._max_exc = 8192
         self._step_cache: dict[tuple, object] = {}
+        self._maint_cache: dict[tuple, object] = {}
+        # donated scratch sets keyed by the static caps; the pipeline holds
+        # one in flight, the pool holds the other
+        self._scratch: dict[tuple, tuple] = {}
+        # device copies of rarely-changing staged arrays (radius, active),
+        # re-uploaded only when values change
+        self._h2d_cache: dict[str, tuple] = {}
+        # pipelined tick awaiting harvest
+        self._inflight = None
+        # per-slot release epoch: a harvest must not publish events (or XOR
+        # mirror traffic) for a slot released after its dispatch
+        self._slot_epoch: dict[int, int] = {}
         # lazily enabled host mirror of the interest words (see
-        # _TPUBucket.peek_words): seeded by one cross-mesh fetch, then kept
-        # current per flush by XOR-ing the decoded change streams
+        # _TPUBucket.peek_words).  Resets apply to it immediately (they only
+        # follow release+reacquire, and the harvest XOR is epoch-guarded);
+        # clears DEFER past an in-flight tick's stream -- that stream was
+        # dispatched with the entity still active, so applying the clear
+        # first would let the XOR re-plant the removed bits (same ordering
+        # rule as _TPUBucket._mirror_apply)
         self._mirror: np.ndarray | None = None
+        self._mirror_ops: list[tuple] = []
+        # growth is the only remaining full-array host round-trip; steady
+        # state (flushes, clears, set/get_prev) must keep this at zero
+        self.full_roundtrips = 0
+        # optimistic per-chip prefetch sizes (rows, escapes, exceptions)
+        self._pred = (256, 64, 256)
+        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
 
     # -- slot management ---------------------------------------------------
     def _grow_to(self, n_slots: int) -> None:
         if n_slots <= self.s_max:
             return
+        self.drain()
         new_s = max(self.n_dev, self.s_max)
         while new_s < n_slots:
             new_s *= 2
@@ -105,12 +145,15 @@ class _MeshTPUBucket(_Bucket):
         prev_h = np.zeros((new_s, self.capacity, self.W), np.uint32)
         if self.prev is not None and self.s_max > 0:
             prev_h[: self.s_max] = np.asarray(self.prev)
+            self.full_roundtrips += 1
         self.prev = self.mesh.device_put(prev_h)
         if self._mirror is not None:
             grown = np.zeros((new_s, self.capacity, self.W), np.uint32)
             grown[: self._mirror.shape[0]] = self._mirror
             self._mirror = grown
         self.s_max = new_s
+        self._h2d_cache.clear()
+        self._scratch.clear()
 
     def _reset_slot(self, slot: int) -> None:
         self._pending_reset.add(slot)
@@ -124,30 +167,42 @@ class _MeshTPUBucket(_Bucket):
         if self._mirror is not None:
             self._mirror[slot] = 0
 
+    def release_slot(self, slot: int) -> None:
+        self._slot_epoch[slot] = self._slot_epoch.get(slot, 0) + 1
+        super().release_slot(slot)
+
     def peek_words(self, slot: int) -> np.ndarray:
         if self._mirror is None:
             self.flush()
-            # C-contiguity is load-bearing: see _TPUBucket.peek_words
+            self.drain()
+            # writable C-contiguous copy is load-bearing: see
+            # _TPUBucket.peek_words
             self._mirror = (np.zeros((self.s_max, self.capacity, self.W),
                                      np.uint32)
                             if self.prev is None
-                            else np.ascontiguousarray(np.asarray(self.prev)))
+                            else np.array(self.prev, np.uint32, copy=True,
+                                          order="C"))
+            if self.prev is not None:
+                self.full_roundtrips += 1  # one-time mirror seed
         return self._mirror[slot]
 
     # -- state carry-over (growth / freeze-restore) ------------------------
     def get_prev(self, slot: int) -> np.ndarray:
         self.flush()
+        self.drain()
         return np.asarray(self.prev[slot])
 
     def set_prev(self, slot: int, words: np.ndarray) -> None:
         self.flush()
+        self.drain()
         self._pending_reset.discard(slot)
-        prev_h = np.array(self.prev)  # writable copy
-        prev_h[slot] = np.asarray(words, np.uint32)
-        self.prev = self.mesh.device_put(prev_h)
+        words = np.ascontiguousarray(words, np.uint32)
+        self.prev = self._set_slot_fn()(self.prev,
+                                        np.int32(slot),
+                                        words)
         self._seeded_unstaged.add(slot)
         if self._mirror is not None:
-            self._mirror[slot] = np.asarray(words, np.uint32)
+            self._mirror[slot] = words
 
     def clear_entity(self, slot: int, entity_slot: int) -> None:
         self._pending_clear.append((slot, entity_slot))
@@ -157,15 +212,120 @@ class _MeshTPUBucket(_Bucket):
         if slot < self._hact.shape[0]:
             self._hact[slot, entity_slot] = False
         if self._mirror is not None:
-            self._mirror[slot, entity_slot, :] = 0
-            w, b = P.word_bit_for_column(entity_slot, self.capacity)
-            self._mirror[slot, :, w] &= np.uint32(
-                ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
+            if self._inflight is not None:
+                self._mirror_ops.append(
+                    (slot, entity_slot, self._slot_epoch.get(slot, 0)))
+            else:
+                self._mirror_clear(slot, entity_slot)
+
+    def _mirror_clear(self, slot: int, entity_slot: int) -> None:
+        self._mirror[slot, entity_slot, :] = 0
+        w, b = P.word_bit_for_column(entity_slot, self.capacity)
+        self._mirror[slot, :, w] &= np.uint32(
+            ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
+
+    # -- jitted helpers (sharding pinned, no host round-trips) -------------
+    def _set_slot_fn(self):
+        fn = self._maint_cache.get("set_slot")
+        if fn is None:
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               out_shardings=self.mesh.sharding())
+            def impl(prev, slot, words):
+                return prev.at[slot].set(words)
+
+            self._maint_cache["set_slot"] = fn = impl
+        return fn
+
+    def _maintenance_fn(self):
+        """One donated device scatter applies all pending slot resets, row
+        clears, and (pre-combined per (slot, word)) column masks."""
+        fn = self._maint_cache.get("maint")
+        if fn is None:
+            import functools
+
+            import jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               out_shardings=self.mesh.sharding())
+            def impl(prev, reset_slots, row_slots, row_ents, col_slots,
+                     col_words, col_masks):
+                # mode="drop": padding uses out-of-bounds indices as true
+                # no-ops.  The col pass MUST pad out of bounds too: an
+                # in-bounds fill that collides with a real (slot, word)
+                # entry would scatter the pre-masked gathered value over
+                # the real clear (duplicate scatter indices, last write
+                # wins) -- caught by the cap-4096 storm test.
+                prev = prev.at[reset_slots].set(0, mode="drop")
+                prev = prev.at[row_slots, row_ents, :].set(0, mode="drop")
+                cols = prev.at[col_slots, :, col_words].get(
+                    mode="fill", fill_value=0) & col_masks[:, None]
+                return prev.at[col_slots, :, col_words].set(cols,
+                                                            mode="drop")
+
+            self._maint_cache["maint"] = fn = impl
+        return fn
+
+    def _apply_maintenance(self) -> None:
+        if not self._pending_reset and not self._pending_clear:
+            return
+        import jax.numpy as jnp
+
+        c = self.capacity
+        noop = self.s_max  # out-of-bounds: dropped by the scatter
+
+        def pad(seq, fill):  # pad to a power of two with no-op entries
+            if not seq:
+                seq = [fill]
+            n = 1
+            while n < len(seq):
+                n *= 2
+            return seq + [fill] * (n - len(seq))
+
+        resets = sorted(self._pending_reset)
+        self._pending_reset.clear()
+        col_mask: dict[tuple[int, int], int] = {}
+        rows = []
+        for slot, e in self._pending_clear:
+            w, b = P.word_bit_for_column(e, c)
+            key = (slot, w)
+            col_mask[key] = col_mask.get(key, 0xFFFFFFFF) & (
+                ~(1 << b) & 0xFFFFFFFF)
+            rows.append((slot, e))
+        self._pending_clear.clear()
+        cols = [(s, w, m) for (s, w), m in col_mask.items()]
+        resets = pad(resets, noop)
+        rows = pad(rows, (noop, 0))
+        # the col fill must not collide with any real (slot, word) pair --
+        # an out-of-bounds word index is dropped by the scatter
+        cols = pad(cols, (0, self.W, 0xFFFFFFFF))
+        self.prev = self._maintenance_fn()(
+            self.prev,
+            jnp.asarray(resets, jnp.int32),
+            jnp.asarray([s for s, _ in rows], jnp.int32),
+            jnp.asarray([e for _, e in rows], jnp.int32),
+            jnp.asarray([s for s, _, _ in cols], jnp.int32),
+            jnp.asarray([w for _, w, _ in cols], jnp.int32),
+            jnp.asarray([m for _, _, m in cols], jnp.uint32),
+        )
+
+    def _h2d(self, role: str, arr: np.ndarray):
+        cached = self._h2d_cache.get(role)
+        if cached is not None and cached[0].shape == arr.shape and \
+                np.array_equal(cached[0], arr):
+            return cached[1]
+        dev = self.mesh.device_put(arr)
+        self._h2d_cache[role] = (arr.copy(), dev)
+        return dev
 
     # -- the fused dispatch ------------------------------------------------
     def _sharded_step(self):
         """Build (or reuse) the jitted shard_map flush for the current
-        static config (s_max, caps)."""
+        static config (s_max, caps).  All large outputs ride DONATED scratch
+        buffers (see engine/aoi._fused_bucket_step for why)."""
         key = (self.s_max, self._max_chunks, self._kcap, self._max_gaps,
                self._max_exc)
         fn = self._step_cache.get(key)
@@ -183,7 +343,8 @@ class _MeshTPUBucket(_Bucket):
         mc, kcap = self._max_chunks, self._kcap
         mg, mx = self._max_gaps, self._max_exc
 
-        def _local(prev, x, z, r, act):
+        def _local(prev, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
+                   x, z, r, act):
             new, chg = aoi_step_pallas(x, z, r, act, prev, emit="chg",
                                        interpret=interpret)
             vals, nv, lane, csel, ccnt, nd, mcc = EV.extract_chunks(
@@ -193,39 +354,73 @@ class _MeshTPUBucket(_Bucket):
                 vals, nv, lane, csel, ccnt, w=_LANES, max_gaps=mg,
                 max_exc=mx)
             scalars = jnp.stack([nd, mcc, base_row, n_esc, exc_n])
-            return (new, chg, vals, nv, lane, csel, rowb, bitpos, woff,
-                    esc_rows, exc_gidx, exc_chg, exc_new, scalars[None])
+            chg_buf = chg_buf.at[:].set(chg)
+            vals_buf = vals_buf.at[:].set(vals)
+            nv_buf = nv_buf.at[:].set(nv)
+            lane_buf = lane_buf.at[:].set(lane)
+            csel_buf = csel_buf.at[:].set(csel)
+            return (new, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
+                    rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+                    exc_new, scalars[None])
 
         spec = PS(self.mesh.axis)
         local = jax.shard_map(
             _local,
             mesh=self.mesh.mesh,
-            in_specs=(spec,) * 5,
+            in_specs=(spec,) * 10,
             out_specs=(spec,) * 14,
             check_vma=False,
         )
-        fn = jax.jit(local, donate_argnums=(0,))
+        fn = jax.jit(local, donate_argnums=(0, 1, 2, 3, 4, 5))
         self._step_cache[key] = fn
         return fn
+
+    def _get_scratch(self):
+        """Donated buffers for one dispatch: (chg [S,C,W], vals/nv [D*mc,k],
+        lane [D*mc,k], csel [D*mc]); sharded over the mesh."""
+        import jax.numpy as jnp
+
+        key = (self.s_max, self._max_chunks, self._kcap)
+        sc = self._scratch.pop(key, None)
+        if sc is not None:
+            return key, sc
+        while len(self._scratch) >= 2:
+            self._scratch.pop(next(iter(self._scratch)))
+        put = self.mesh.device_put
+        mc, kcap = self._max_chunks, self._kcap
+        n = self.n_dev * mc
+        sc = (
+            put(np.zeros((self.s_max, self.capacity, self.W), np.uint32)),
+            put(np.zeros((n, kcap), np.uint32)),
+            put(np.zeros((n, kcap), np.uint32)),
+            put(np.full((n, kcap), -1, np.int32)),
+            put(np.zeros(n, np.int32)),
+        )
+        return key, sc
 
     def flush(self) -> None:
         if (not self._staged and not self._pending_reset
                 and not self._pending_clear):
+            if self._inflight is not None:
+                self._harvest()
             return
-        c = self.capacity
-        if self._pending_reset or self._pending_clear:
-            prev_h = np.array(self.prev)  # writable copy
-            if self._pending_reset:
-                prev_h[sorted(self._pending_reset)] = 0
-                self._pending_reset.clear()
-            for slot, e in self._pending_clear:
-                prev_h[slot, e, :] = 0
-                w, b = P.word_bit_for_column(e, c)
-                prev_h[slot, :, w] &= np.uint32(
-                    ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
-            self._pending_clear.clear()
-            self.prev = self.mesh.device_put(prev_h)
+        t0 = time.perf_counter()
+        if self.pipeline and self._inflight is not None:
+            # peek the inflight tick's scalars (async-fetched at its
+            # dispatch, host-local by now): a ROW overflow recovery reads
+            # the NEW interest words, i.e. self.prev -- which maintenance
+            # below mutates (a clear would flip that tick's enters for the
+            # cleared entity to leaves) and the next dispatch donates.
+            # Harvest BEFORE both in that rare case; the pipeline stalls
+            # one tick instead of misclassifying or reading freed memory.
+            nd_mcc = np.asarray(self._inflight["scalars"])[:, :2]
+            mc_i, kcap_i = self._inflight["caps"][:2]
+            if (nd_mcc[:, 0] > mc_i).any() or (nd_mcc[:, 1] > kcap_i).any():
+                self._harvest()
+        self._apply_maintenance()
         if not self._staged:
+            if self._inflight is not None:
+                self._harvest()
             return
 
         staged_slots = sorted(self._staged)
@@ -247,35 +442,97 @@ class _MeshTPUBucket(_Bucket):
                 % sorted(self._seeded_unstaged))
 
         put = self.mesh.device_put
+        key, scratch = self._get_scratch()
         out = self._sharded_step()(
-            self.prev, put(self._hx), put(self._hz), put(self._hr),
-            put(self._hact))
+            self.prev, *scratch, put(self._hx), put(self._hz),
+            self._h2d("r", self._hr), self._h2d("act", self._hact))
         (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos,
          woff, esc_rows, exc_gidx, exc_chg, exc_new, scalars) = out
         self.prev = new  # the step's new words ARE next tick's prev
-        scal_h = np.asarray(scalars)  # [n_dev, 5]
+        scalars.copy_to_host_async()
+        rec = {
+            "slots": staged_slots,
+            "epochs": {s: self._slot_epoch.get(s, 0)
+                       for s in range(self.s_max)},
+            "key": key, "caps": (self._max_chunks, self._kcap,
+                                 self._max_gaps, self._max_exc),
+            "scratch": (chg, g_vals, g_nv, g_lane, g_csel),
+            "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+                        exc_new),
+            "scalars": scalars,
+            "prefetch": None,
+        }
+        if self.pipeline:
+            # optimistic per-chip prefetch at recently observed stream
+            # sizes; the harvest refetches exact slices on a misfit
+            mc = self._max_chunks
+            ndp = min(mc, self._pred[0])
+            escp = min(self._max_gaps, self._pred[1])
+            excp = min(self._max_exc, self._pred[2])
+            slices = []
+            for d in range(self.n_dev):
+                slices.append((
+                    rowb[d * mc:d * mc + ndp],
+                    bitpos[d * mc:d * mc + ndp],
+                    woff[d * mc:d * mc + ndp],
+                    esc_rows[d * self._max_gaps:d * self._max_gaps + escp],
+                    exc_gidx[d * self._max_exc:d * self._max_exc + excp],
+                    exc_chg[d * self._max_exc:d * self._max_exc + excp],
+                    exc_new[d * self._max_exc:d * self._max_exc + excp],
+                ))
+                for a in slices[-1]:
+                    a.copy_to_host_async()
+            rec["prefetch"] = (ndp, escp, excp, slices)
+        prev_rec, self._inflight = self._inflight, rec
+        self.perf["stage_s"] += time.perf_counter() - t0
+        if self.pipeline:
+            if prev_rec is not None:
+                self._harvest(prev_rec)
+        else:
+            self._harvest()
+
+    def drain(self) -> None:
+        if self._inflight is not None:
+            self._harvest()
+
+    def _harvest(self, rec=None) -> None:
+        if rec is None:
+            rec, self._inflight = self._inflight, None
+        c = self.capacity
+        mc, kcap, mg, mx = rec["caps"]
         s_local = self.s_max // self.n_dev
-        mc, kcap = self._max_chunks, self._kcap
-        mg, mx = self._max_gaps, self._max_exc
         chunk_base = s_local * c * self.W // _LANES  # chunks per chip
+        (chg, g_vals, g_nv, g_lane, g_csel) = rec["scratch"]
+        (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+         exc_new) = rec["streams"]
+        t0 = time.perf_counter()
+        scal_h = np.asarray(rec["scalars"])  # [n_dev, 5]
+        self.perf["fetch_s"] += time.perf_counter() - t0
+        pf = rec["prefetch"]
         all_c, all_e, all_g = [], [], []
         grew = False
+        peak = [0, 0, 0]  # per-chip maxima of (nd, n_esc, exc_n) this tick
         for d in range(self.n_dev):
             nd, mcc, base_row, n_esc, exc_n = (int(v) for v in scal_h[d])
             if nd == 0 and exc_n == 0:
                 continue
+            t0 = time.perf_counter()
             if nd > mc or mcc > kcap:
                 # this chip's stream is incomplete: recover from its raw
-                # diff grids, grow the caps for the next flush
+                # diff grid, grow the caps for the next flush.  self.prev
+                # still holds this tick's NEW words -- flush() harvests an
+                # overflowing tick BEFORE the next dispatch donates prev
+                # (see the scalar peek there), so the read is safe.
                 self._max_chunks = max(self._max_chunks, 2 * nd)
                 self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
                 grew = True
                 lo = d * s_local
                 chg_h = np.asarray(chg[lo:lo + s_local]).reshape(-1)
-                new_h = np.asarray(new[lo:lo + s_local]).reshape(-1)
+                new_h = np.asarray(self.prev[lo:lo + s_local]).reshape(-1)
                 gidx = np.nonzero(chg_h)[0]
                 chg_vals = chg_h[gidx]
                 ent_vals = chg_vals & new_h[gidx]
+                self.perf["fetch_s"] += time.perf_counter() - t0
             elif n_esc > mg or exc_n > mx:
                 # encode overflow: rebuild from the kept chunk grids
                 self._max_gaps = max(mg, 2 * n_esc)
@@ -290,27 +547,67 @@ class _MeshTPUBucket(_Bucket):
                 chg_vals = vh[valid]
                 ent_vals = chg_vals & nh[valid]
                 gidx = (ch[:, None].astype(np.int64) * _LANES + lh)[valid]
+                self.perf["fetch_s"] += time.perf_counter() - t0
             else:
+                if pf is not None and pf[0] >= nd and pf[1] >= n_esc \
+                        and pf[2] >= exc_n:
+                    hb = [np.asarray(a) for a in pf[3][d]]
+                else:
+                    nds = max(nd, 1)
+                    hb = [np.asarray(a) for a in (
+                        rowb[d * mc:d * mc + nds],
+                        bitpos[d * mc:d * mc + nds],
+                        woff[d * mc:d * mc + nds],
+                        esc_rows[d * mg:d * mg + max(n_esc, 1)],
+                        exc_gidx[d * mx:d * mx + max(exc_n, 1)],
+                        exc_chg[d * mx:d * mx + max(exc_n, 1)],
+                        exc_new[d * mx:d * mx + max(exc_n, 1)])]
+                self.perf["fetch_s"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
                 chg_vals, ent_vals, gidx = EV.decode_row_stream(
-                    np.asarray(rowb[d * mc:d * mc + max(nd, 1)]),
-                    np.asarray(bitpos[d * mc:d * mc + max(nd, 1)]),
-                    np.asarray(woff[d * mc:d * mc + max(nd, 1)]
-                               ).astype(np.uint16),
-                    base_row, nd, _LANES,
-                    np.asarray(esc_rows[d * mg:d * mg + max(n_esc, 1)]),
-                    np.asarray(exc_gidx[d * mx:d * mx + max(exc_n, 1)]),
-                    np.asarray(exc_chg[d * mx:d * mx + max(exc_n, 1)]),
-                    np.asarray(exc_new[d * mx:d * mx + max(exc_n, 1)]))
+                    hb[0], hb[1], hb[2].astype(np.uint16), base_row, nd,
+                    _LANES, hb[3], hb[4], hb[5], hb[6])
+                self.perf["decode_s"] += time.perf_counter() - t0
+            peak = [max(peak[0], nd), max(peak[1], n_esc),
+                    max(peak[2], exc_n)]
             # chip-local flat word index -> global
             all_c.append(chg_vals)
             all_e.append(ent_vals)
             all_g.append(np.asarray(gidx, np.int64) + d * chunk_base * _LANES)
         if grew:
             self._step_cache.clear()  # static caps changed
+            self._scratch.clear()
+        # refit the next dispatch's optimistic prefetch to THIS tick's
+        # per-chip peaks (fresh, not a running max: prefetch sizes must
+        # decay after a storm or every later tick ships storm-sized slices)
+        self._pred = (
+            max(256, min(mc, -(-(peak[0] * 5 // 4) // 128) * 128)),
+            max(64, -(-(peak[1] + 1) * 3 // 2 // 64) * 64),
+            max(256, -(-(peak[2] + 1) * 5 // 4 // 256) * 256),
+        )
+        t0 = time.perf_counter()
+        epochs = rec["epochs"]
+        live = np.fromiter(
+            (self._slot_epoch.get(s, 0) == epochs.get(s, 0)
+             for s in range(self.s_max)), bool, self.s_max)
         if self._mirror is not None and all_g:
             gx = np.concatenate(all_g)
             if len(gx):
-                self._mirror.reshape(-1)[gx] ^= np.concatenate(all_c)
+                cv = np.concatenate(all_c)
+                # epoch guard: a slot released since dispatch had its mirror
+                # reset at re-acquire; the dead stream must not XOR back in
+                keep = live[gx // (c * self.W)]
+                if not keep.all():
+                    gx, cv = gx[keep], cv[keep]
+                self._mirror.reshape(-1)[gx] ^= cv
+        if self._mirror is not None and self._mirror_ops:
+            # clears issued after this tick's dispatch apply now, AFTER its
+            # stream; the epoch tag drops ops whose slot was released since
+            # (a reacquired slot may carry freshly seeded set_prev words)
+            ops, self._mirror_ops = self._mirror_ops, []
+            for slot, e, ep in ops:
+                if self._slot_epoch.get(slot, 0) == ep:
+                    self._mirror_clear(slot, e)
         empty = np.empty((0, 2), np.int32)
         if all_c:
             pe, pl = EV.expand_classified_host(
@@ -320,6 +617,11 @@ class _MeshTPUBucket(_Bucket):
             pe = pl = np.empty((0, 3), np.int32)
         ent_rows = _split_rows(pe)
         lv_rows = _split_rows(pl)
-        for slot in staged_slots:
+        for slot in rec["slots"]:
+            if not live[slot]:
+                continue  # released since dispatch: events of a dead space
             self._events[slot] = (ent_rows.get(slot, empty),
                                   lv_rows.get(slot, empty))
+        # the harvested scratch returns to the pool for reuse
+        self._scratch.setdefault(rec["key"], rec["scratch"])
+        self.perf["decode_s"] += time.perf_counter() - t0

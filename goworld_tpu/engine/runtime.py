@@ -82,10 +82,6 @@ class Runtime:
         spaces = list(self.entities.spaces.values())
         staged = False
         for sp in spaces:
-            # slots freed last tick become reusable now: with a pipelined
-            # calculator, events harvested this tick may still reference a
-            # slot freed last tick -- same-tick reuse would misattribute them
-            sp.recycle_aoi_slots()
             staged = sp.submit_aoi() or staged
         # a pipelined bucket may hold an inflight tick even when nothing new
         # is staged (trailing flush); events can land on any AOI space, not
@@ -94,6 +90,13 @@ class Runtime:
             self.aoi.flush()
             for sp in spaces:
                 sp.dispatch_aoi_events()
+        # slots freed last tick become reusable only NOW, after event
+        # delivery: with a pipelined calculator, events replayed this phase
+        # may reference a slot freed last tick, and recycling before the
+        # replay would let an entity created inside an on_leave_aoi hook
+        # take the slot and inherit the dead occupant's pending enter pairs
+        for sp in spaces:
+            sp.recycle_aoi_slots()
 
     def _sync_phase(self):
         """Collect position sync + flush attr deltas for DIRTY entities only

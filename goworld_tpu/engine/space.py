@@ -57,10 +57,14 @@ class Space(Entity):
         # (derive_interests; Entity.neighbors)
         self._nonplain = np.zeros(0, bool)
         self._free_slots: list[int] = []
-        # slots freed this tick; recycled at the NEXT tick's AOI phase so a
-        # pipelined calculator's one-tick-late events can never land on a
-        # reused slot (runtime.recycle_aoi_slots)
+        # two-stage cooling for freed slots: a pipelined calculator's events
+        # for a slot freed during tick T are dispatched at T and only
+        # DELIVERED at T+1's AOI phase, so the slot must stay unallocatable
+        # through the end of T+1 -- not just this tick's phase (timers and
+        # user code between ticks allocate too).  recycle_aoi_slots advances
+        # cooling -> cooling2 -> free at the end of each AOI phase.
         self._free_cooling: list[int] = []
+        self._free_cooling2: list[int] = []
         self._slot_watermark = 0
         self._aoi_dirty = False
 
@@ -235,9 +239,15 @@ class Space(Entity):
 
     # -- per-tick AOI ------------------------------------------------------
     def recycle_aoi_slots(self):
-        """Release slots freed last tick for reuse (see ``_free_cooling``)."""
+        """Advance the two-stage cooling pipeline (see ``_free_cooling``).
+        Called at the END of each AOI phase, after event delivery: a slot
+        freed during tick T becomes allocatable only after T+1's delivery
+        of the events dispatched while it was live."""
+        if self._free_cooling2:
+            self._free_slots.extend(self._free_cooling2)
+            self._free_cooling2.clear()
         if self._free_cooling:
-            self._free_slots.extend(self._free_cooling)
+            self._free_cooling2.extend(self._free_cooling)
             self._free_cooling.clear()
 
     def submit_aoi(self) -> bool:

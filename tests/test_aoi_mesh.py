@@ -234,3 +234,127 @@ def test_runtime_tick_on_mesh():
             e.destroy()
         rt.tick()
     assert canon("mesh") == canon("cpu")
+
+
+def drive_pipelined(eng, handles, scenarios):
+    """Like drive(), but for a pipelined engine: events arrive one tick
+    late, so flush once more at the end and return len(scenarios[0]) + 1
+    batches (batch 0 is empty)."""
+    out = []
+    for t in range(len(scenarios[0])):
+        for h, sc in zip(handles, scenarios):
+            x, z, r, act = sc[t]
+            eng.submit(h, x, z, r, act)
+        eng.flush()
+        out.append([eng.take_events(h) for h in handles])
+    eng.flush()  # trailing: harvests the last dispatched tick
+    out.append([eng.take_events(h) for h in handles])
+    return out
+
+
+def test_mesh_pipelined_flush_parity():
+    """Round-3 verdict item 4: mesh x pipeline compose.  The pipelined mesh
+    bucket's events are bit-identical to the CPU oracle, shifted one tick."""
+    mesh = make_mesh(8)
+    eng = AOIEngine(default_backend="tpu", mesh=mesh, pipeline=True)
+    oracle = AOIEngine(default_backend="cpu")
+    cap, n, spaces, ticks = 1024, 900, 16, 3
+    scenarios = [walk(s, cap, n, ticks) for s in range(spaces)]
+    hs = [eng.create_space(cap) for _ in range(spaces)]
+    ohs = [oracle.create_space(cap) for _ in range(spaces)]
+    mesh_out = drive_pipelined(eng, hs, scenarios)
+    cpu_out = drive(oracle, ohs, scenarios)
+    for s in range(spaces):
+        assert mesh_out[0][s][0].size == 0 and mesh_out[0][s][1].size == 0, (
+            "pipelined flush delivered events same-tick")
+    for t in range(ticks):
+        for s in range(spaces):
+            me, ml = mesh_out[t + 1][s]
+            ce, cl = cpu_out[t][s]
+            np.testing.assert_array_equal(me, ce, err_msg=f"enter t={t} s={s}")
+            np.testing.assert_array_equal(ml, cl, err_msg=f"leave t={t} s={s}")
+
+
+def test_mesh_pipelined_clear_and_release_epochs():
+    """clear_entity and slot release while a mesh tick is in flight: the
+    dead traffic must not surface (events or mirror bits)."""
+    mesh = make_mesh(8)
+    eng = AOIEngine(default_backend="tpu", mesh=mesh, pipeline=True)
+    cap = 256
+    hs = [eng.create_space(cap) for _ in range(8)]
+    b = hs[0].bucket
+    b.peek_words(hs[0].slot)  # enable the mirror before any traffic
+    x = np.array([0.0, 5.0, 10.0], np.float32)
+    r = np.full(3, 50, np.float32)
+    act = np.ones(3, bool)
+    for h in hs:
+        eng.submit(h, x, x, r, act)
+    eng.flush()  # tick 1 in flight (enter pairs for all spaces)
+    # space 0: entity 1 departs while in flight; space 1: whole space dies
+    eng.clear_entity(hs[0], 1)
+    eng.release_space(hs[1])
+    act2 = act.copy(); act2[1] = False
+    eng.submit(hs[0], x, x, r, act2)
+    for h in hs[2:]:
+        eng.submit(h, x, x, r, act)
+    eng.flush()
+    # tick 1's events: space 0 keeps (0,2) pairs only after the replayed
+    # clear; space 1's events are dropped wholesale (dead epoch)
+    e0, _ = eng.take_events(hs[0])
+    assert len(e0) == 6  # all 3x2 ordered pairs of tick 1 (clear postdates)
+    assert eng.take_events(hs[1])[0].size == 0
+    eng.flush()
+    b.drain()
+    w0 = b.peek_words(hs[0].slot)
+    from goworld_tpu.ops import aoi_predicate as P
+    m = P.unpack_rows(w0, cap)
+    assert m[0, 2] and m[2, 0], "surviving pair lost"
+    assert not m[0, 1] and not m[1, 0] and not m[1, 2], (
+        "cleared entity's bits re-planted by the in-flight stream")
+    # the dead space's slot mirror must be empty for its next occupant
+    h_new = eng.create_space(cap)
+    if h_new.slot == hs[1].slot:
+        assert not b.peek_words(h_new.slot).any()
+
+
+def test_mesh_cap4096_clear_storm_no_full_roundtrips():
+    """Round-3 verdict item 7: maintenance must not round-trip the full
+    [S, C, W] interest state.  Cap 4096 with a clear storm; the bucket's
+    full_roundtrips counter stays zero through staging, flushes, a storm,
+    and set/get_prev of single slots."""
+    mesh = make_mesh(8)
+    eng = AOIEngine(default_backend="tpu", mesh=mesh)
+    oracle = AOIEngine(default_backend="cpu")
+    cap, n = 4096, 600
+    rng = np.random.default_rng(3)
+    x = rng.uniform(0, 1200, n).astype(np.float32)
+    z = rng.uniform(0, 1200, n).astype(np.float32)
+    r = np.full(n, 70, np.float32)
+    act = np.ones(n, bool)
+    h = eng.create_space(cap)
+    oh = oracle.create_space(cap)
+    for e, o in ((eng, h), (oracle, oh)):
+        e.submit(o, x, z, r, act)
+    eng.flush(); oracle.flush()
+    np.testing.assert_array_equal(eng.take_events(h)[0],
+                                  oracle.take_events(oh)[0])
+    gone = rng.choice(n, 150, replace=False)
+    act2 = act.copy(); act2[gone] = False
+    for slot in gone:
+        eng.clear_entity(h, int(slot))
+        oracle.clear_entity(oh, int(slot))
+    eng.submit(h, x, z, r, act2)
+    oracle.submit(oh, x, z, r, act2)
+    eng.flush(); oracle.flush()
+    me, ml = eng.take_events(h)
+    ce, cl = oracle.take_events(oh)
+    np.testing.assert_array_equal(me, ce)
+    np.testing.assert_array_equal(ml, cl)
+    assert len(ml) == 0  # the storm is silent
+    # single-slot state carry: ships one slot's words, not the full array
+    words = h.bucket.get_prev(h.slot)
+    h.bucket.set_prev(h.slot, words)
+    eng.submit(h, x, z, r, act2)
+    eng.flush()
+    assert h.bucket.full_roundtrips == 0, (
+        "full-array host round-trip on the steady-state path")

@@ -174,3 +174,37 @@ def test_pipelined_mirror_survives_clear_ordering():
     assert not words.any(), (
         "ghost interest bits survived the in-flight clear: %r"
         % words[words != 0])
+
+
+def test_pipelined_mirror_reset_on_slot_reuse():
+    """A slot released and re-acquired while a tick is in flight: the new
+    occupant must never see the dead space's interest words (the reset
+    applies to the mirror immediately), and the dead epoch's in-flight
+    change stream must not XOR back into the reset mirror at harvest."""
+    from goworld_tpu.engine.aoi import AOIEngine
+
+    eng = AOIEngine(default_backend="tpu", pipeline=True)
+    h = eng.create_space(128)
+    b = h.bucket
+    b.peek_words(h.slot)  # enable the mirror BEFORE any traffic
+    x = np.array([0.0, 5.0], np.float32)
+    r = np.full(2, 50, np.float32)
+    act = np.ones(2, bool)
+    eng.submit(h, x, x, r, act)
+    eng.flush()  # tick 1 in flight, carrying the dead pair's change stream
+    old_slot = h.slot
+    eng.release_space(h)
+    h2 = eng.create_space(128)
+    assert h2.slot == old_slot, "expected slot reuse"
+    assert not b.peek_words(h2.slot).any(), (
+        "dead space's words visible to the new occupant before harvest")
+    # new occupant: entities far apart -- its true interest words are zero,
+    # so any leaked dead-epoch XOR (the 0<->1 pair bits) is visible
+    x2 = np.array([900.0, 2000.0], np.float32)
+    eng.submit(h2, x2, x2, r, act)
+    eng.flush()   # harvests the dead tick; its stream must be dropped
+    b.drain()
+    words = b.peek_words(h2.slot)
+    assert not words.any(), (
+        "dead epoch's stream XORed into the reused slot's mirror: %r"
+        % words[words != 0])
