@@ -258,8 +258,11 @@ def cmd_build(args) -> int:
     native_dir = os.path.join(os.path.dirname(__file__), "..", "native")
     native_dir = os.path.abspath(native_dir)
     if os.path.exists(os.path.join(native_dir, "Makefile")):
+        targets = ["all"] + (["sanitize"] if getattr(args, "sanitize", False)
+                             else [])
         r = subprocess.run(
-            ["make", "-C", native_dir], capture_output=True, text=True
+            ["make", "-C", native_dir] + targets, capture_output=True,
+            text=True
         )
         if r.returncode != 0:
             print(f"native build failed:\n{r.stdout}{r.stderr}",
@@ -316,6 +319,9 @@ def main(argv=None) -> int:
                 p.add_argument("--restore", action="store_true")
         p.set_defaults(fn=fn)
     p = sub.add_parser("build")
+    p.add_argument("--sanitize", action="store_true",
+                   help="also build ASAN+UBSAN variants of the native libs "
+                        "(the reference's covertest -race analog)")
     p.add_argument("-c", "--config", default=None)
     p.add_argument("-s", "--script", default=None)
     p.set_defaults(fn=cmd_build)

@@ -19,7 +19,11 @@ import threading
 import zlib
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
-_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libgwlz.so"))
+# GW_SANITIZED_NATIVE=1 loads the ASAN+UBSAN build (make sanitize) instead
+_GWLZ_SO_NAME = ("libgwlz.san.so"
+                 if os.environ.get("GW_SANITIZED_NATIVE") == "1"
+                 else "libgwlz.so")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, _GWLZ_SO_NAME))
 
 _build_lock = threading.Lock()
 _gwlz = None
@@ -184,7 +188,7 @@ def _load_gwlz():
         if not os.path.exists(_SO_PATH):
             try:
                 subprocess.run(
-                    ["make", "-C", _NATIVE_DIR, "-s"],
+                    ["make", "-C", _NATIVE_DIR, "-s", _GWLZ_SO_NAME],
                     check=True,
                     capture_output=True,
                     timeout=120,

@@ -27,7 +27,12 @@ _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native",
 )
-_SO_PATH = os.path.join(_NATIVE_DIR, "libgwaoi.so")
+# GW_SANITIZED_NATIVE=1 loads the ASAN+UBSAN build (make sanitize) instead
+# -- the sanitizer harness runs the same python callers against it
+_SO_NAME = ("libgwaoi.san.so"
+            if os.environ.get("GW_SANITIZED_NATIVE") == "1"
+            else "libgwaoi.so")
+_SO_PATH = os.path.join(_NATIVE_DIR, _SO_NAME)
 _lib = None
 _tried = False
 _build_lock = threading.Lock()
@@ -44,7 +49,7 @@ def _load():
         if not os.path.exists(_SO_PATH):
             try:
                 subprocess.run(
-                    ["make", "-C", _NATIVE_DIR, "-s", "libgwaoi.so"],
+                    ["make", "-C", _NATIVE_DIR, "-s", _SO_NAME],
                     check=True, capture_output=True, timeout=120,
                 )
             except Exception:

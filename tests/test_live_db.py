@@ -1,0 +1,116 @@
+"""Opt-in live-server runs for the wire drivers (``GW_LIVE_DB=1``).
+
+The hermetic wire servers (ext/db/mongowire, mysqlwire) are written by the
+same author as the drivers, so driver and fake can share a wrong protocol
+assumption and still agree.  This module breaks that circularity: the SAME
+client-side exercises run against a real mongod / mysqld when one is
+reachable -- the analog of the reference CI's live services
+(/root/reference/.travis.yml:27-35).
+
+Enable with ``GW_LIVE_DB=1``; point at non-default servers with
+``GW_LIVE_MONGO=host:port`` and ``GW_LIVE_MYSQL=user:pass@host:port/db``
+(the mysql db must exist and the user must be allowed DDL).  Unreachable
+servers skip with a reason rather than fail, so the flag is safe to leave
+on in an environment where only one service runs.
+"""
+
+import os
+import socket
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("GW_LIVE_DB") != "1",
+    reason="live-DB runs are opt-in: set GW_LIVE_DB=1")
+
+
+def _reachable(host: str, port: int) -> bool:
+    try:
+        with socket.create_connection((host, port), timeout=2):
+            return True
+    except OSError:
+        return False
+
+
+def _mongo_addr():
+    spec = os.environ.get("GW_LIVE_MONGO", "127.0.0.1:27017")
+    host, _, port = spec.rpartition(":")
+    return host, int(port)
+
+
+def _mysql_spec():
+    spec = os.environ.get("GW_LIVE_MYSQL", "root:@127.0.0.1:3306/test")
+    cred, _, rest = spec.rpartition("@")
+    user, _, password = cred.partition(":")
+    hostport, _, db = rest.partition("/")
+    host, _, port = hostport.rpartition(":")
+    return user, password, host, int(port), db
+
+
+def test_live_mongo_wire():
+    host, port = _mongo_addr()
+    if not _reachable(host, port):
+        pytest.skip(f"no mongod at {host}:{port}")
+    from goworld_tpu.ext.db.mongowire import MongoWireClient
+
+    c = MongoWireClient(host=host, port=port)
+    col = c["gw_live_test"]["t"]
+    col.delete_many({})
+    col.insert_one({"_id": "k1", "v": 1, "blob": b"\x00\xffbin",
+                    "nested": {"a": [1, 2.5, "s", None, True]}})
+    doc = col.find_one({"_id": "k1"})
+    assert doc["v"] == 1 and bytes(doc["blob"]) == b"\x00\xffbin"
+    assert doc["nested"]["a"][1] == 2.5
+    col.update_one({"_id": "k1"}, {"$set": {"v": 2}}, upsert=True)
+    assert col.find_one({"_id": "k1"})["v"] == 2
+    assert col.count_documents({}) == 1
+    # cursor paging: force getMore batches
+    for i in range(300):
+        col.insert_one({"_id": f"p{i}", "v": i})
+    assert len(list(col.find({}))) == 301
+    col.delete_many({})
+    c.close()
+
+
+def test_live_mongo_storage_backend():
+    host, port = _mongo_addr()
+    if not _reachable(host, port):
+        pytest.skip(f"no mongod at {host}:{port}")
+    from test_db_backends import _exercise_entity_storage
+
+    from goworld_tpu.storage.backends import new_entity_storage
+
+    be = new_entity_storage(
+        {"type": "mongodb", "url": f"mongodb://{host}:{port}",
+         "db": "gw_live_test"})
+    _exercise_entity_storage(be)
+
+
+def test_live_mysql_wire():
+    user, password, host, port, db = _mysql_spec()
+    if not _reachable(host, port):
+        pytest.skip(f"no mysqld at {host}:{port}")
+    from goworld_tpu.ext.db.mysqlwire import MySQLWireClient
+
+    c = MySQLWireClient(host=host, port=port, user=user, password=password,
+                        database=db)
+    cur = c.cursor()
+    cur.execute("DROP TABLE IF EXISTS gw_live_t")
+    cur.execute("CREATE TABLE gw_live_t "
+                "(k VARCHAR(64) PRIMARY KEY, v BLOB, n TEXT)")
+    # the exact dual-dialect surface the hermetic server mirrors: ''
+    # doubling, hex literals, NULL, and backslashes under the
+    # NO_BACKSLASH_ESCAPES mode the client pins at connect
+    rows = [("key'1", b"\x00\x01bin", None),
+            ("trailing\\", b"x", "a\\'b"),
+            ("c:\\dir\\n", bytes(range(256)), "plain")]
+    for k, v, n in rows:
+        cur.execute("REPLACE INTO gw_live_t (k, v, n) VALUES (%s, %s, %s)",
+                    (k, v, n))
+    for k, v, n in rows:
+        cur.execute("SELECT k, v, n FROM gw_live_t WHERE k = %s", (k,))
+        assert cur.fetchone() == (k, v, n)
+    cur.execute("SELECT COUNT(*) FROM gw_live_t")
+    assert cur.fetchone()[0] == len(rows)
+    cur.execute("DROP TABLE gw_live_t")
+    c.close()
