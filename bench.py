@@ -1010,8 +1010,11 @@ def run_config(cfg, companion=False):
             import copy
 
             c2 = copy.copy(cfg)
-            c2.cadence, c2.chunk, c2.reps = "device", 1, 2
-            c2.ticks = min(cfg.ticks, 10)
+            # keep the scan chunking: per-tick stats are ~28 B, so with
+            # chunk=1 the tunnel round trip per dispatch (~80 ms) would
+            # dominate the 13 ms device tick and understate the rate 6x
+            c2.cadence, c2.reps = "device", 2
+            c2.ticks = min(cfg.ticks, 20)
             q2 = make_walk(c2, np.random.default_rng(0), c2.ticks)
             comp = bench_tpu_device_cadence(c2, *q2)
             tpu["device_cadence_moves_per_sec"] = round(

@@ -91,11 +91,18 @@ class _MeshTPUBucket(_Bucket):
         # slots seeded via set_prev that have not been staged since (see
         # module docstring)
         self._seeded_unstaged: set[int] = set()
-        # per-chip extraction caps (static shapes; grow on overflow)
+        # per-chip extraction caps (static shapes; grow on overflow, decay
+        # on a short doubling window like the single-chip bucket so a
+        # mass-enter storm stops pessimizing later flushes)
         self._max_chunks = 1024
         self._kcap = 8
         self._max_gaps = 2048
         self._max_exc = 8192
+        self._peak_nd = 0
+        self._peak_mcc = 0
+        self._flushes = 0
+        self._refit_at = 8
+        self._steady = False
         self._step_cache: dict[tuple, object] = {}
         self._maint_cache: dict[tuple, object] = {}
         # donated scratch sets keyed by the static caps; the pipeline holds
@@ -512,6 +519,7 @@ class _MeshTPUBucket(_Bucket):
         all_c, all_e, all_g = [], [], []
         grew = False
         peak = [0, 0, 0]  # per-chip maxima of (nd, n_esc, exc_n) this tick
+        peak_mcc = 0
         for d in range(self.n_dev):
             nd, mcc, base_row, n_esc, exc_n = (int(v) for v in scal_h[d])
             if nd == 0 and exc_n == 0:
@@ -570,6 +578,7 @@ class _MeshTPUBucket(_Bucket):
                 self.perf["decode_s"] += time.perf_counter() - t0
             peak = [max(peak[0], nd), max(peak[1], n_esc),
                     max(peak[2], exc_n)]
+            peak_mcc = max(peak_mcc, mcc)
             # chip-local flat word index -> global
             all_c.append(chg_vals)
             all_e.append(ent_vals)
@@ -577,6 +586,30 @@ class _MeshTPUBucket(_Bucket):
         if grew:
             self._step_cache.clear()  # static caps changed
             self._scratch.clear()
+            # a storm must not anchor the next decay window's peak
+            self._peak_nd = self._peak_mcc = 0
+            self._flushes = 0
+            self._refit_at = 8
+            self._steady = False
+        else:
+            self._peak_nd = max(self._peak_nd, peak[0])
+            self._peak_mcc = max(self._peak_mcc, peak_mcc)
+            self._flushes += 1
+            if self._flushes >= self._refit_at:
+                fit_nd = max(1024, -(-self._peak_nd * 3 // 2 // 512) * 512)
+                fit_k = min(max(8, 1 << (self._peak_mcc * 2 - 1)
+                                .bit_length()), _LANES)
+                if fit_nd < self._max_chunks or fit_k < self._kcap:
+                    self._max_chunks = min(self._max_chunks, fit_nd)
+                    self._kcap = min(self._kcap, fit_k)
+                    self._step_cache.clear()
+                    self._scratch.clear()
+                    self._steady = False  # one more clean window confirms
+                else:
+                    self._steady = True
+                self._peak_nd = self._peak_mcc = 0
+                self._flushes = 0
+                self._refit_at = min(self._refit_at * 2, 128)
         # refit the next dispatch's optimistic prefetch to THIS tick's
         # per-chip peaks (fresh, not a running max: prefetch sizes must
         # decay after a storm or every later tick ships storm-sized slices)

@@ -54,6 +54,14 @@ _COM_PING = 0x0E
 
 _TYPE_VAR_STRING = 0xFD
 _TYPE_BLOB = 0xFC
+_TYPE_LONGLONG = 0x08
+_TYPE_DOUBLE = 0x05
+# the text protocol ships every value as a string; the DRIVER converts by
+# declared column type, so numeric results (COUNT(*), SUM, int columns)
+# come back as python numbers from a real mysqld and the hermetic server
+# alike
+_INT_TYPES = frozenset({0x01, 0x02, 0x03, 0x08, 0x09, 0x0D, 0x10})
+_FLOAT_TYPES = frozenset({0x04, 0x05, 0x00, 0xF6})
 _CHARSET_UTF8 = 33
 _CHARSET_BINARY = 63
 
@@ -305,6 +313,10 @@ class MySQLWireClient:
                     elif charset == _CHARSET_BINARY and ctype in (
                             _TYPE_BLOB, 0xF9, 0xFA, 0xFB):
                         vals.append(bytes(raw))
+                    elif ctype in _INT_TYPES:
+                        vals.append(int(raw))
+                    elif ctype in _FLOAT_TYPES:
+                        vals.append(float(raw))
                     else:
                         vals.append(raw.decode("utf-8"))
                 rows.append(tuple(vals))
@@ -405,6 +417,14 @@ class _Handler(socketserver.BaseRequestHandler):
             sample = next((r[i] for r in rows if r[i] is not None), None)
             if isinstance(sample, bytes):
                 ctype, charset = _TYPE_BLOB, _CHARSET_BINARY
+            elif isinstance(sample, bool):
+                ctype, charset = _TYPE_VAR_STRING, _CHARSET_UTF8
+            elif isinstance(sample, int):
+                # declare what a real mysqld declares for integer results
+                # so the driver's type-directed decode agrees byte-for-byte
+                ctype, charset = _TYPE_LONGLONG, _CHARSET_UTF8
+            elif isinstance(sample, float):
+                ctype, charset = _TYPE_DOUBLE, _CHARSET_UTF8
             else:
                 ctype, charset = _TYPE_VAR_STRING, _CHARSET_UTF8
             types.append((ctype, charset))

@@ -12,6 +12,11 @@ Enable with ``GW_LIVE_DB=1``; point at non-default servers with
 (the mysql db must exist and the user must be allowed DDL).  Unreachable
 servers skip with a reason rather than fail, so the flag is safe to leave
 on in an environment where only one service runs.
+
+The drive bodies are shared with default-suite tests that run them against
+the hermetic servers -- opt-in-only test code is unexecuted code, and an
+API drift in a live test would otherwise go unnoticed until someone
+finally has a real server (which is how round 4's review caught two).
 """
 
 import os
@@ -19,7 +24,7 @@ import socket
 
 import pytest
 
-pytestmark = pytest.mark.skipif(
+_live = pytest.mark.skipif(
     os.environ.get("GW_LIVE_DB") != "1",
     reason="live-DB runs are opt-in: set GW_LIVE_DB=1")
 
@@ -47,10 +52,9 @@ def _mysql_spec():
     return user, password, host, int(port), db
 
 
-def test_live_mongo_wire():
-    host, port = _mongo_addr()
-    if not _reachable(host, port):
-        pytest.skip(f"no mongod at {host}:{port}")
+# -- shared drive bodies -----------------------------------------------------
+
+def drive_mongo_wire(host: int, port: int) -> None:
     from goworld_tpu.ext.db.mongowire import MongoWireClient
 
     c = MongoWireClient(host=host, port=port)
@@ -61,7 +65,7 @@ def test_live_mongo_wire():
     doc = col.find_one({"_id": "k1"})
     assert doc["v"] == 1 and bytes(doc["blob"]) == b"\x00\xffbin"
     assert doc["nested"]["a"][1] == 2.5
-    col.update_one({"_id": "k1"}, {"$set": {"v": 2}}, upsert=True)
+    col.replace_one({"_id": "k1"}, {"_id": "k1", "v": 2}, upsert=True)
     assert col.find_one({"_id": "k1"})["v"] == 2
     assert col.count_documents({}) == 1
     # cursor paging: force getMore batches
@@ -72,24 +76,17 @@ def test_live_mongo_wire():
     c.close()
 
 
-def test_live_mongo_storage_backend():
-    host, port = _mongo_addr()
-    if not _reachable(host, port):
-        pytest.skip(f"no mongod at {host}:{port}")
+def drive_mongo_storage(host: str, port: int) -> None:
     from test_db_backends import _exercise_entity_storage
 
     from goworld_tpu.storage.backends import new_entity_storage
 
-    be = new_entity_storage(
-        {"type": "mongodb", "url": f"mongodb://{host}:{port}",
-         "db": "gw_live_test"})
+    be = new_entity_storage("mongodb", host=host, port=port,
+                            db="gw_live_test")
     _exercise_entity_storage(be)
 
 
-def test_live_mysql_wire():
-    user, password, host, port, db = _mysql_spec()
-    if not _reachable(host, port):
-        pytest.skip(f"no mysqld at {host}:{port}")
+def drive_mysql_wire(user, password, host, port, db) -> None:
     from goworld_tpu.ext.db.mysqlwire import MySQLWireClient
 
     c = MySQLWireClient(host=host, port=port, user=user, password=password,
@@ -114,3 +111,52 @@ def test_live_mysql_wire():
     assert cur.fetchone()[0] == len(rows)
     cur.execute("DROP TABLE gw_live_t")
     c.close()
+
+
+# -- default suite: the same drives against the hermetic servers -------------
+
+def test_drives_against_hermetic_mongo():
+    from goworld_tpu.ext.db.mongowire import MiniMongoServer
+
+    srv = MiniMongoServer()
+    try:
+        drive_mongo_wire("127.0.0.1", srv.port)
+        drive_mongo_storage("127.0.0.1", srv.port)
+    finally:
+        srv.close()
+
+
+def test_drive_against_hermetic_mysql():
+    from goworld_tpu.ext.db.mysqlwire import MiniMySQLServer
+
+    srv = MiniMySQLServer()
+    try:
+        drive_mysql_wire("root", "", "127.0.0.1", srv.port, "")
+    finally:
+        srv.close()
+
+
+# -- opt-in: real servers ----------------------------------------------------
+
+@_live
+def test_live_mongo_wire():
+    host, port = _mongo_addr()
+    if not _reachable(host, port):
+        pytest.skip(f"no mongod at {host}:{port}")
+    drive_mongo_wire(host, port)
+
+
+@_live
+def test_live_mongo_storage_backend():
+    host, port = _mongo_addr()
+    if not _reachable(host, port):
+        pytest.skip(f"no mongod at {host}:{port}")
+    drive_mongo_storage(host, port)
+
+
+@_live
+def test_live_mysql_wire():
+    user, password, host, port, db = _mysql_spec()
+    if not _reachable(host, port):
+        pytest.skip(f"no mysqld at {host}:{port}")
+    drive_mysql_wire(user, password, host, port, db)

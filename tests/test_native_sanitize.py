@@ -73,8 +73,11 @@ print("SAN_OK")
 
 
 def _runtime(name):
-    r = subprocess.run(["g++", f"-print-file-name={name}"],
-                       capture_output=True, text=True)
+    try:
+        r = subprocess.run(["g++", f"-print-file-name={name}"],
+                           capture_output=True, text=True)
+    except FileNotFoundError:
+        return None  # no gcc: skip, don't error
     p = r.stdout.strip()
     return p if os.path.sep in p and os.path.exists(p) else None
 
