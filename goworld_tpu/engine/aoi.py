@@ -149,6 +149,54 @@ def _fused_bucket_step(prev_all, *args):
     return _fused_impl(prev_all, *args)
 
 
+class _CapDecay:
+    """Windowed decay of adaptive extraction caps, shared by the TPU
+    buckets (single-chip and mesh).  Growth on overflow is the owner's
+    job; this tracks window peaks and proposes shrinks on a SHORT doubling
+    window -- a one-off mass tick (space fill, restore storm) must not
+    pessimize hundreds of later flushes with storm-sized extraction grids.
+    ``steady`` turns True once a window check passes with nothing to
+    change, i.e. the static compile key is final; benchmarks warm up until
+    then."""
+
+    def __init__(self, nd_floor: int):
+        self.nd_floor = nd_floor
+        self.peak_nd = 0
+        self.peak_mcc = 0
+        self.flushes = 0
+        self.refit_at = 8
+        self.steady = False
+
+    def reset_after_growth(self) -> None:
+        """The storm that grew the caps must not anchor the next window's
+        peak, or the post-storm shrink waits a full window."""
+        self.peak_nd = self.peak_mcc = 0
+        self.flushes = 0
+        self.refit_at = 8
+        self.steady = False
+
+    def observe(self, nd: int, mcc: int, cur_nd: int,
+                cur_k: int) -> tuple[int, int] | None:
+        """Track one flush's peaks; at the window boundary return the
+        shrunk ``(max_chunks, kcap)`` to adopt, or None."""
+        self.peak_nd = max(self.peak_nd, nd)
+        self.peak_mcc = max(self.peak_mcc, mcc)
+        self.flushes += 1
+        if self.flushes < self.refit_at:
+            return None
+        fit_nd = max(self.nd_floor, -(-self.peak_nd * 3 // 2 // 512) * 512)
+        fit_k = min(max(8, 1 << (self.peak_mcc * 2 - 1).bit_length()),
+                    _LANES)
+        self.peak_nd = self.peak_mcc = 0
+        self.flushes = 0
+        self.refit_at = min(self.refit_at * 2, 128)
+        if fit_nd < cur_nd or fit_k < cur_k:
+            self.steady = False  # one more clean window confirms
+            return min(cur_nd, fit_nd), min(cur_k, fit_k)
+        self.steady = True
+        return None
+
+
 @dataclass
 class SpaceAOIHandle:
     backend: str
@@ -487,22 +535,11 @@ class _TPUBucket(_Bucket):
         self._pending_reset: set[int] = set()
         self._pending_clear: list[tuple[int, int]] = []  # (slot, entity_slot)
         # adaptive extraction caps; a tick that exceeds them is recovered
-        # host-side from the full diff and the caps grow for the next tick.
-        # A sliding peak window decays them again, so a one-off mass tick
-        # (space fill, restore storm) doesn't pessimize every later flush.
-        # The window starts SHORT and doubles after each check: the common
-        # storm is the mass-enter at space fill, and a 128-flush window
-        # left the engine dragging a 131072-chunk extraction grid (and its
-        # ~100 MB scratch) for hundreds of ordinary ~600-chunk ticks.
+        # host-side from the full diff and the caps grow for the next tick;
+        # _CapDecay shrinks them back toward the steady state
         self._max_chunks = 4096
         self._kcap = 8
-        self._peak_nd = 0
-        self._peak_mcc = 0
-        self._refit_at = 8  # flushes until the next decay check (doubles)
-        self._flushes = 0
-        # True once a decay check has run and found the caps already fit --
-        # i.e. no recompile is pending; benchmarks warm up until here
-        self._steady = False
+        self._caps = _CapDecay(nd_floor=4096)
         # donated scratch buffers, keyed (s_n, mc, kcap); replaced by each
         # flush's returns (same device memory, in-place)
         self._scratch: dict[tuple, tuple] = {}
@@ -526,6 +563,11 @@ class _TPUBucket(_Bucket):
         # deltas to attribute engine ms/tick between host logic, wire, and
         # decode -- two perf_counter pairs per flush, noise-level cost.
         self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
+
+    @property
+    def _steady(self) -> bool:
+        """No cap recompile pending (see _CapDecay; benchmarks read this)."""
+        return self._caps.steady
 
     def _grow_to(self, n_slots: int) -> None:
         jnp = self._jnp
@@ -724,37 +766,16 @@ class _TPUBucket(_Bucket):
         t_f0 = time.perf_counter()
         nd, mcc, base_row, n_esc, exc_n = (int(v) for v in
                                            np.asarray(rec["scalars"]))
-        self._peak_nd = max(self._peak_nd, nd)
-        self._peak_mcc = max(self._peak_mcc, mcc)
-        self._flushes += 1
-        if self._flushes >= self._refit_at:
-            # decay toward the recent window's peaks (bounded below by the
-            # defaults) so caps track the steady state, not history's worst
-            fit_nd = max(4096, -(-self._peak_nd * 3 // 2 // 512) * 512)
-            fit_k = min(max(8, 1 << (self._peak_mcc * 2 - 1).bit_length()),
-                        _LANES)
-            if fit_nd < self._max_chunks or fit_k < self._kcap:
-                self._max_chunks = min(self._max_chunks, fit_nd)
-                self._kcap = min(self._kcap, fit_k)
-                self._steady = False  # one more clean window confirms
-            else:
-                self._steady = True
-            self._peak_nd = self._peak_mcc = 0
-            self._flushes = 0
-            self._refit_at = min(self._refit_at * 2, 128)
+        shrink = self._caps.observe(nd, mcc, self._max_chunks, self._kcap)
+        if shrink is not None:
+            self._max_chunks, self._kcap = shrink
         if nd > mc or mcc > kcap:
             # caps exceeded: recover this tick from the full diff, then grow
             # the caps so the next tick extracts on device again
             self._max_chunks = max(self._max_chunks, 2 * nd)
             # a chunk holds at most _LANES nonzero words
             self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
-            # the storm that grew the caps must not anchor the next decay
-            # window's peak, or the post-storm shrink waits a full window
-            # with storm-sized extraction grids (and their scratch)
-            self._peak_nd = self._peak_mcc = 0
-            self._flushes = 0
-            self._refit_at = 8
-            self._steady = False
+            self._caps.reset_after_growth()
             chg_h = np.asarray(chg).reshape(-1)
             new_h = np.asarray(new).reshape(-1)
             gidx = np.nonzero(chg_h)[0]

@@ -62,7 +62,7 @@ import numpy as np
 
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import _Bucket, _split_rows
+from .aoi import _Bucket, _CapDecay, _split_rows
 
 _LANES = 128
 
@@ -92,17 +92,13 @@ class _MeshTPUBucket(_Bucket):
         # module docstring)
         self._seeded_unstaged: set[int] = set()
         # per-chip extraction caps (static shapes; grow on overflow, decay
-        # on a short doubling window like the single-chip bucket so a
-        # mass-enter storm stops pessimizing later flushes)
+        # via the shared _CapDecay window so a mass-enter storm stops
+        # pessimizing later flushes)
         self._max_chunks = 1024
         self._kcap = 8
         self._max_gaps = 2048
         self._max_exc = 8192
-        self._peak_nd = 0
-        self._peak_mcc = 0
-        self._flushes = 0
-        self._refit_at = 8
-        self._steady = False
+        self._caps = _CapDecay(nd_floor=1024)
         self._step_cache: dict[tuple, object] = {}
         self._maint_cache: dict[tuple, object] = {}
         # donated scratch sets keyed by the static caps; the pipeline holds
@@ -131,6 +127,11 @@ class _MeshTPUBucket(_Bucket):
         # optimistic per-chip prefetch sizes (rows, escapes, exceptions)
         self._pred = (256, 64, 256)
         self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
+
+    @property
+    def _steady(self) -> bool:
+        """No cap recompile pending (see aoi._CapDecay)."""
+        return self._caps.steady
 
     # -- slot management ---------------------------------------------------
     def _grow_to(self, n_slots: int) -> None:
@@ -586,30 +587,14 @@ class _MeshTPUBucket(_Bucket):
         if grew:
             self._step_cache.clear()  # static caps changed
             self._scratch.clear()
-            # a storm must not anchor the next decay window's peak
-            self._peak_nd = self._peak_mcc = 0
-            self._flushes = 0
-            self._refit_at = 8
-            self._steady = False
+            self._caps.reset_after_growth()
         else:
-            self._peak_nd = max(self._peak_nd, peak[0])
-            self._peak_mcc = max(self._peak_mcc, peak_mcc)
-            self._flushes += 1
-            if self._flushes >= self._refit_at:
-                fit_nd = max(1024, -(-self._peak_nd * 3 // 2 // 512) * 512)
-                fit_k = min(max(8, 1 << (self._peak_mcc * 2 - 1)
-                                .bit_length()), _LANES)
-                if fit_nd < self._max_chunks or fit_k < self._kcap:
-                    self._max_chunks = min(self._max_chunks, fit_nd)
-                    self._kcap = min(self._kcap, fit_k)
-                    self._step_cache.clear()
-                    self._scratch.clear()
-                    self._steady = False  # one more clean window confirms
-                else:
-                    self._steady = True
-                self._peak_nd = self._peak_mcc = 0
-                self._flushes = 0
-                self._refit_at = min(self._refit_at * 2, 128)
+            shrink = self._caps.observe(peak[0], peak_mcc,
+                                        self._max_chunks, self._kcap)
+            if shrink is not None:
+                self._max_chunks, self._kcap = shrink
+                self._step_cache.clear()
+                self._scratch.clear()
         # refit the next dispatch's optimistic prefetch to THIS tick's
         # per-chip peaks (fresh, not a running max: prefetch sizes must
         # decay after a storm or every later tick ships storm-sized slices)

@@ -59,8 +59,10 @@ _TYPE_DOUBLE = 0x05
 # the text protocol ships every value as a string; the DRIVER converts by
 # declared column type, so numeric results (COUNT(*), SUM, int columns)
 # come back as python numbers from a real mysqld and the hermetic server
-# alike
-_INT_TYPES = frozenset({0x01, 0x02, 0x03, 0x08, 0x09, 0x0D, 0x10})
+# alike.  BIT (0x10) is deliberately absent: its text-protocol form is raw
+# bytes, not decimal text.  Conversion failures fall back to the string
+# (defensive: a server may declare a type its values don't parse as).
+_INT_TYPES = frozenset({0x01, 0x02, 0x03, 0x08, 0x09, 0x0D})
 _FLOAT_TYPES = frozenset({0x04, 0x05, 0x00, 0xF6})
 _CHARSET_UTF8 = 33
 _CHARSET_BINARY = 63
@@ -313,10 +315,12 @@ class MySQLWireClient:
                     elif charset == _CHARSET_BINARY and ctype in (
                             _TYPE_BLOB, 0xF9, 0xFA, 0xFB):
                         vals.append(bytes(raw))
-                    elif ctype in _INT_TYPES:
-                        vals.append(int(raw))
-                    elif ctype in _FLOAT_TYPES:
-                        vals.append(float(raw))
+                    elif ctype in _INT_TYPES or ctype in _FLOAT_TYPES:
+                        try:
+                            vals.append(int(raw) if ctype in _INT_TYPES
+                                        else float(raw))
+                        except ValueError:
+                            vals.append(raw.decode("utf-8"))
                     else:
                         vals.append(raw.decode("utf-8"))
                 rows.append(tuple(vals))
@@ -414,16 +418,18 @@ class _Handler(socketserver.BaseRequestHandler):
         # column types inferred from the first non-null value per column
         types = []
         for i, name in enumerate(names):
-            sample = next((r[i] for r in rows if r[i] is not None), None)
-            if isinstance(sample, bytes):
+            vals = [r[i] for r in rows if r[i] is not None]
+            if vals and all(isinstance(v, bytes) for v in vals):
                 ctype, charset = _TYPE_BLOB, _CHARSET_BINARY
-            elif isinstance(sample, bool):
-                ctype, charset = _TYPE_VAR_STRING, _CHARSET_UTF8
-            elif isinstance(sample, int):
+            elif vals and all(isinstance(v, int)
+                              and not isinstance(v, bool) for v in vals):
                 # declare what a real mysqld declares for integer results
                 # so the driver's type-directed decode agrees byte-for-byte
                 ctype, charset = _TYPE_LONGLONG, _CHARSET_UTF8
-            elif isinstance(sample, float):
+            elif vals and all(isinstance(v, (int, float))
+                              and not isinstance(v, bool) for v in vals):
+                # sqlite columns are typeless: a mixed int/float column
+                # must declare DOUBLE, not the first row's type
                 ctype, charset = _TYPE_DOUBLE, _CHARSET_UTF8
             else:
                 ctype, charset = _TYPE_VAR_STRING, _CHARSET_UTF8

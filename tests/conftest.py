@@ -29,12 +29,12 @@ if os.environ.get("GW_TPU_TESTS") != "1":
     import sys
 
     if "jax" in sys.modules:
-        import jax
+        try:  # private API: best-effort, never break collection over it
+            import jax
 
-        from jax._src import xla_bridge as _xb
+            from jax._src import xla_bridge as _xb
 
-        if not _xb.backends_are_initialized():
-            try:
+            if not _xb.backends_are_initialized():
                 jax.config.update("jax_platforms", "cpu")
-            except Exception:
-                pass
+        except Exception:
+            pass
