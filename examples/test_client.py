@@ -2,6 +2,10 @@
 speaking the full client protocol with strict assertions and a per-op
 latency profiler, ClientBot.go / ClientEntity.go / profile.go:19-51).
 
+Pairs with the ``examples/unity_demo`` game script (its Avatar exposes the
+``enter_game``/``move`` RPC surface the bots drive); ``examples/test_game``
+is the in-process everything-at-once scene exercised by tests/test_examples.
+
     python examples/test_client.py --gate 127.0.0.1:17001 -N 50 \
         --duration 30 --strict --profile 1
 

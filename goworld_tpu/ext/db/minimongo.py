@@ -2,11 +2,11 @@
 
 Implements exactly the client surface the mongodb STORAGE and KVDB
 backends use -- ``client[db][coll]`` with ``insert_one`` (duplicate _id
-raises), ``replace_one(upsert=)``, ``find_one``, ``find``
-(+``sort``/projection/limit), ``count_documents``,
+raises), ``replace_one(upsert=)``, ``update_one`` ($set/$unset/$inc),
+``find_one``, ``find`` (+``sort``/projection/limit), ``count_documents``,
 ``delete_one``/``delete_many``.  (NOT a full pymongo fake: gwdoc's
-PymongoEngine needs result objects, update_one/update_many and index
-management -- run that against a real pymongo.)  Backends accept an
+PymongoEngine needs result objects (``matched_count``), ``update_many``
+and index management -- run that against a real pymongo.)  Backends accept an
 injected client, so their logic runs under test in this image (no mongod,
 no pymongo); against a real deployment the same code gets a real
 ``pymongo.MongoClient``.
@@ -105,6 +105,44 @@ class MiniCollection:
                     return
             if upsert:
                 self._docs[doc.get("_id")] = dict(doc)
+
+    def update_one(self, flt: dict, update: dict, upsert: bool = False):
+        """Operator update ($set / $unset / $inc) on the first match; an
+        upsert seeds the new document from the filter's equality fields
+        (mongo's rule) before applying the operators."""
+        ops = {k: update[k] for k in ("$set", "$unset", "$inc")
+               if k in update}
+        unknown = set(update) - set(ops)
+        if unknown:
+            raise ValueError(f"unsupported update operators {unknown}")
+
+        def apply(d: dict) -> dict:
+            for k, v in ops.get("$set", {}).items():
+                d[k] = v
+            for k in ops.get("$unset", {}):
+                d.pop(k, None)
+            for k, v in ops.get("$inc", {}).items():
+                d[k] = d.get(k, 0) + v
+            return d
+
+        with self._lock:
+            for _id, d in self._docs.items():
+                if _match(d, flt):
+                    self._docs[_id] = apply(dict(d))
+                    return
+            if upsert:
+                # mongo's upsert seed: the filter's equality conditions
+                # (embedded-document values included; only operator
+                # documents like {"$gt": 3} are conditions, not values)
+                seed = {k: v for k, v in flt.items()
+                        if not (isinstance(v, dict)
+                                and any(kk.startswith("$") for kk in v))}
+                doc = apply(seed)
+                if doc.get("_id") is None:
+                    import uuid
+
+                    doc["_id"] = uuid.uuid4().hex  # ObjectId stand-in
+                self._docs[doc["_id"]] = doc
 
     def find_one(self, flt: dict | None = None) -> dict | None:
         with self._lock:

@@ -170,12 +170,29 @@ class _WireCollection:
                 raise DuplicateKeyError(errs[0].get("errmsg", "duplicate key"))
             raise MongoWireError(str(errs[0]))
 
-    def replace_one(self, flt: dict, doc: dict, upsert: bool = False) -> None:
-        self._db._cmd({
+    def _update(self, flt: dict, u: dict, upsert: bool) -> None:
+        r = self._db._cmd({
             "update": self.name,
-            "updates": [{"q": flt, "u": doc, "upsert": upsert,
+            "updates": [{"q": flt, "u": u, "upsert": upsert,
                          "multi": False}],
         })
+        # a real mongod reports per-statement failures as ok:1 +
+        # writeErrors; swallowing them would turn failed updates into
+        # silent no-ops (the hermetic server raises ok:0 instead)
+        errs = r.get("writeErrors")
+        if errs:
+            raise MongoWireError(str(errs[0]))
+
+    def replace_one(self, flt: dict, doc: dict, upsert: bool = False) -> None:
+        self._update(flt, doc, upsert)
+
+    def update_one(self, flt: dict, update: dict,
+                   upsert: bool = False) -> None:
+        """Operator update (``{"$set": {...}}`` etc.) -- same wire command
+        as replace_one; the ``u`` document's ``$``-prefixed keys select the
+        operator path on the server (real mongod and the hermetic server
+        alike)."""
+        self._update(flt, update, upsert)
 
     def find_one(self, flt: dict | None = None) -> dict | None:
         for d in _WireCursor(self, flt, None).limit(1):
@@ -352,8 +369,15 @@ class _Handler(socketserver.BaseRequestHandler):
                 n = 0
                 for u in cmd.get("updates", []):
                     before = coll.count_documents(u.get("q", {}), limit=1)
-                    coll.replace_one(u.get("q", {}), u.get("u", {}),
-                                     upsert=bool(u.get("upsert")))
+                    ud = u.get("u", {})
+                    if any(k.startswith("$") for k in ud):
+                        # operator document ($set/...), mongo's other
+                        # update shape besides full replacement
+                        coll.update_one(u.get("q", {}), ud,
+                                        upsert=bool(u.get("upsert")))
+                    else:
+                        coll.replace_one(u.get("q", {}), ud,
+                                         upsert=bool(u.get("upsert")))
                     n += max(before,
                              1 if u.get("upsert") else before)
                 return {"n": n, "nModified": n, "ok": 1.0}

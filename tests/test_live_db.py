@@ -67,6 +67,12 @@ def drive_mongo_wire(host: int, port: int) -> None:
     assert doc["nested"]["a"][1] == 2.5
     col.replace_one({"_id": "k1"}, {"_id": "k1", "v": 2}, upsert=True)
     assert col.find_one({"_id": "k1"})["v"] == 2
+    col.update_one({"_id": "k1"}, {"$set": {"v": 3}, "$inc": {"n": 2}})
+    doc = col.find_one({"_id": "k1"})
+    assert doc["v"] == 3 and doc["n"] == 2
+    col.update_one({"_id": "up1"}, {"$set": {"v": 9}}, upsert=True)
+    assert col.find_one({"_id": "up1"})["v"] == 9
+    col.delete_one({"_id": "up1"})
     assert col.count_documents({}) == 1
     # cursor paging: force getMore batches
     for i in range(300):
