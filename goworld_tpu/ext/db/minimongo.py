@@ -104,7 +104,12 @@ class MiniCollection:
                     self._docs[_id] = dict(doc)
                     return
             if upsert:
-                self._docs[doc.get("_id")] = dict(doc)
+                _id = doc.get("_id")
+                if _id in self._docs:
+                    # the filter did not match but the _id exists: a real
+                    # mongod's upsert-insert hits the unique index
+                    raise DuplicateKeyError(f"duplicate _id {_id!r}")
+                self._docs[_id] = dict(doc)
 
     def update_one(self, flt: dict, update: dict, upsert: bool = False):
         """Operator update ($set / $unset / $inc) on the first match; an
@@ -115,6 +120,15 @@ class MiniCollection:
         unknown = set(update) - set(ops)
         if unknown:
             raise ValueError(f"unsupported update operators {unknown}")
+
+        for op in ops.values():
+            for k in op:
+                if "." in k:
+                    # dotted paths address NESTED fields in mongo; storing
+                    # a literal "a.b" key would silently diverge -- raise,
+                    # matching this fake's unsupported-shape contract
+                    raise ValueError(
+                        f"dotted update paths unsupported: {k!r}")
 
         def apply(d: dict) -> dict:
             for k, v in ops.get("$set", {}).items():
@@ -142,6 +156,9 @@ class MiniCollection:
                     import uuid
 
                     doc["_id"] = uuid.uuid4().hex  # ObjectId stand-in
+                elif doc["_id"] in self._docs:
+                    raise DuplicateKeyError(
+                        f"duplicate _id {doc['_id']!r}")
                 self._docs[doc["_id"]] = doc
 
     def find_one(self, flt: dict | None = None) -> dict | None:

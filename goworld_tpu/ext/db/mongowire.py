@@ -181,6 +181,9 @@ class _WireCollection:
         # silent no-ops (the hermetic server raises ok:0 instead)
         errs = r.get("writeErrors")
         if errs:
+            if errs[0].get("code") == 11000:
+                raise DuplicateKeyError(
+                    errs[0].get("errmsg", "duplicate key"))
             raise MongoWireError(str(errs[0]))
 
     def replace_one(self, flt: dict, doc: dict, upsert: bool = False) -> None:
@@ -192,6 +195,11 @@ class _WireCollection:
         as replace_one; the ``u`` document's ``$``-prefixed keys select the
         operator path on the server (real mongod and the hermetic server
         alike)."""
+        if not update or not all(k.startswith("$") for k in update):
+            # pymongo's contract: a plain document here would silently
+            # take the replacement path and wipe the other fields
+            raise ValueError("update_one requires $-operator documents "
+                             "(use replace_one for full replacement)")
         self._update(flt, update, upsert)
 
     def find_one(self, flt: dict | None = None) -> dict | None:

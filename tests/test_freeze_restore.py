@@ -66,9 +66,17 @@ def make_game(cfg, tmp):
     return gs
 
 
-def test_freeze_restore_under_client(tmp_path):
+@pytest.mark.parametrize("aoi_extra", [
+    "",
+    "aoi_backend = tpu\naoi_mesh_devices = 8\naoi_pipeline = true\n",
+], ids=["cpu", "mesh-tpu-pipelined"])
+def test_freeze_restore_under_client(tmp_path, aoi_extra):
+    """The freeze path must carry interest state across ANY calculator --
+    including the pipelined mesh bucket, whose set_prev/seeded-slot
+    contract (stage before next flush) the restore path must honor."""
     tmp = str(tmp_path)
-    cfg = gwconfig.loads(CONFIG)
+    cfg = gwconfig.loads(CONFIG.replace(
+        "boot_entity = RAvatar", "boot_entity = RAvatar\n" + aoi_extra))
     disp = DispatcherService(1, cfg).start()
     cfg.dispatchers[1].host, cfg.dispatchers[1].port = disp.addr
     g = make_game(cfg, tmp)
