@@ -44,8 +44,14 @@ class DispatcherConfig:
 
 @dataclass
 class GameConfig:
-    aoi_backend: str = "cpu"  # cpu (python sweep) | cpp (native sweep) | tpu
-    # >0 with aoi_backend=tpu: shard every tpu bucket's spaces over an
+    # cpu (python sweep) | cpp (native sweep) | tpu | auto (route each
+    # space by capacity: >= aoi_tpu_min_capacity goes to the tpu bucket,
+    # smaller spaces to the native host calculator -- a 1k-entity space is
+    # dispatch-bound on an accelerator while the native sweep finishes in
+    # microseconds; a 8k+ space is the reverse)
+    aoi_backend: str = "cpu"
+    aoi_tpu_min_capacity: int = 4096
+    # >0 with aoi_backend=tpu/auto: shard every tpu bucket's spaces over an
     # N-device mesh (engine/aoi_mesh); 0 = single device
     aoi_mesh_devices: int = 0
     # double-buffer the tpu flush: AOI events arrive one tick late, device

@@ -199,11 +199,14 @@ class _CapDecay:
 
 @dataclass
 class SpaceAOIHandle:
-    backend: str
+    backend: str        # resolved (cpu | cpp | tpu)
     capacity: int
     bucket: "_Bucket"
     slot: int
     released: bool = False
+    # the backend as REQUESTED (may be "auto"); growth re-resolves it, so
+    # a space that grows past the routing threshold moves to the tpu bucket
+    requested: str = ""
 
 
 class AOIEngine:
@@ -216,9 +219,14 @@ class AOIEngine:
 
     def __init__(self, default_backend: str = "cpu",
                  oracle_algorithm: str = "sweep", mesh=None,
-                 pipeline: bool = False):
+                 pipeline: bool = False, tpu_min_capacity: int = 4096):
         self.default_backend = default_backend
         self.oracle_algorithm = oracle_algorithm
+        # "auto" routing threshold: spaces below it go to the native host
+        # calculator (a tiny space is dispatch-bound on an accelerator;
+        # the native sweep finishes in microseconds), larger ones to the
+        # tpu bucket where the batched kernel wins
+        self.tpu_min_capacity = tpu_min_capacity
         if isinstance(mesh, int):
             from ..parallel import SpaceMesh, multichip_devices
 
@@ -229,7 +237,7 @@ class AOIEngine:
         # the mesh bucket implements the same contract per chip)
         self.pipeline = pipeline
         self._buckets: dict[tuple[str, int], _Bucket] = {}
-        if default_backend == "tpu":
+        if default_backend in ("tpu", "auto"):
             # fail FAST at process boot, not on the first space's first
             # tick: a game configured for tpu whose jax backend is broken
             # (e.g. an explicitly requested device plugin that cannot load)
@@ -276,8 +284,15 @@ class AOIEngine:
                     )
 
     def create_space(self, capacity: int, backend: str | None = None) -> SpaceAOIHandle:
-        backend = backend or self.default_backend
+        requested = backend or self.default_backend
         capacity = P.round_capacity(capacity)
+        backend = requested
+        if backend == "auto":
+            # capacity routing: tiny spaces are dispatch-bound on an
+            # accelerator (the native sweep finishes them in microseconds);
+            # large ones belong on the batched kernel
+            backend = ("tpu" if capacity >= self.tpu_min_capacity
+                       else "cpp")
         key = (backend, capacity)
         bucket = self._buckets.get(key)
         if bucket is None:
@@ -314,7 +329,8 @@ class AOIEngine:
                 raise ValueError(f"unknown AOI backend {backend!r}")
             self._buckets[key] = bucket
         slot = bucket.acquire_slot()
-        return SpaceAOIHandle(backend, capacity, bucket, slot)
+        return SpaceAOIHandle(backend, capacity, bucket, slot,
+                              requested=requested)
 
     def release_space(self, h: SpaceAOIHandle) -> None:
         if not h.released:
@@ -369,7 +385,7 @@ class AOIEngine:
         m = P.unpack_rows(old_words, h.capacity)
         grown = np.zeros((new_capacity, new_capacity), bool)
         grown[: h.capacity, : h.capacity] = m
-        nh = self.create_space(new_capacity, h.backend)
+        nh = self.create_space(new_capacity, h.requested or h.backend)
         nh.bucket.set_prev(nh.slot, P.pack_rows(grown))
         # carry undelivered events: growth can happen between flush() and
         # dispatch_aoi_events() (e.g. an on_enter_aoi hook spawns entities);
@@ -869,6 +885,14 @@ class _TPUBucket(_Bucket):
                 continue
             e = ent_rows.get(row, empty)
             l = lv_rows.get(row, empty)
+            pend = self._events.get(slot)
+            if pend is not None:
+                # a mid-dispatch harvest (grow_space inside an AOI hook
+                # calls get_prev -> flush) can land while another space's
+                # prior-tick events are still undelivered: APPEND, never
+                # clobber -- replay order stays oldest-first
+                e = np.concatenate([pend[0], e])
+                l = np.concatenate([pend[1], l])
             self._events[slot] = (e, l)
         self.perf["decode_s"] += time.perf_counter() - t_f0
 

@@ -638,8 +638,15 @@ class _MeshTPUBucket(_Bucket):
         for slot in rec["slots"]:
             if not live[slot]:
                 continue  # released since dispatch: events of a dead space
-            self._events[slot] = (ent_rows.get(slot, empty),
-                                  lv_rows.get(slot, empty))
+            e = ent_rows.get(slot, empty)
+            l = lv_rows.get(slot, empty)
+            pend = self._events.get(slot)
+            if pend is not None:
+                # mid-dispatch harvest with undelivered prior events:
+                # append, never clobber (see _TPUBucket._harvest)
+                e = np.concatenate([pend[0], e])
+                l = np.concatenate([pend[1], l])
+            self._events[slot] = (e, l)
         # the harvested scratch returns to the pool for reuse
         self._scratch.setdefault(rec["key"], rec["scratch"])
         self.perf["decode_s"] += time.perf_counter() - t0

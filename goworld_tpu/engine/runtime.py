@@ -38,6 +38,7 @@ class Runtime:
         on_error: Callable[[BaseException], None] | None = None,
         aoi_mesh=None,
         aoi_pipeline: bool = False,
+        aoi_tpu_min_capacity: int = 4096,
     ):
         self.now = now
         self.on_error = on_error or self._default_on_error
@@ -45,7 +46,8 @@ class Runtime:
         self.post = PostQueue()
         self.crontab = Crontab()
         self.aoi = AOIEngine(default_backend=aoi_backend, mesh=aoi_mesh,
-                             pipeline=aoi_pipeline)
+                             pipeline=aoi_pipeline,
+                             tpu_min_capacity=aoi_tpu_min_capacity)
         self.entities = EntityManager(self)
         self.tick_count = 0
         # entities with pending sync flags / attr deltas / quiet countdowns;

@@ -105,7 +105,12 @@ class MiniCollection:
                     return
             if upsert:
                 _id = doc.get("_id")
-                if _id in self._docs:
+                if _id is None:
+                    import uuid
+
+                    _id = uuid.uuid4().hex  # ObjectId stand-in
+                    doc = dict(doc, _id=_id)
+                elif _id in self._docs:
                     # the filter did not match but the _id exists: a real
                     # mongod's upsert-insert hits the unique index
                     raise DuplicateKeyError(f"duplicate _id {_id!r}")

@@ -375,20 +375,31 @@ class _Handler(socketserver.BaseRequestHandler):
                 return out
             if name == "update":
                 n = 0
-                for u in cmd.get("updates", []):
+                errs = []
+                for i, u in enumerate(cmd.get("updates", [])):
                     before = coll.count_documents(u.get("q", {}), limit=1)
                     ud = u.get("u", {})
-                    if any(k.startswith("$") for k in ud):
-                        # operator document ($set/...), mongo's other
-                        # update shape besides full replacement
-                        coll.update_one(u.get("q", {}), ud,
-                                        upsert=bool(u.get("upsert")))
-                    else:
-                        coll.replace_one(u.get("q", {}), ud,
-                                         upsert=bool(u.get("upsert")))
+                    try:
+                        if any(k.startswith("$") for k in ud):
+                            # operator document ($set/...), mongo's other
+                            # update shape besides full replacement
+                            coll.update_one(u.get("q", {}), ud,
+                                            upsert=bool(u.get("upsert")))
+                        else:
+                            coll.replace_one(u.get("q", {}), ud,
+                                             upsert=bool(u.get("upsert")))
+                    except DuplicateKeyError as e:
+                        # a real mongod reports an upsert-insert racing a
+                        # unique index as ok:1 + writeErrors code 11000
+                        errs.append({"index": i, "code": 11000,
+                                     "errmsg": str(e)})
+                        continue
                     n += max(before,
                              1 if u.get("upsert") else before)
-                return {"n": n, "nModified": n, "ok": 1.0}
+                out = {"n": n, "nModified": n, "ok": 1.0}
+                if errs:
+                    out["writeErrors"] = errs
+                return out
             if name == "find":
                 cur = coll.find(cmd.get("filter") or {},
                                 cmd.get("projection"))

@@ -251,3 +251,82 @@ def test_pipelined_release_drops_stale_events():
     eng.flush()  # harvests h1's inflight tick: must drop its events
     e, l = eng.take_events(h2)
     assert len(e) == 0 and len(l) == 0, "dead space's events leaked"
+
+
+def test_auto_backend_routes_by_capacity():
+    """aoi_backend="auto": tiny spaces go to the native host calculator
+    (dispatch-bound on an accelerator), large ones to the tpu bucket; a
+    growth across the threshold re-resolves and carries interest state."""
+    from goworld_tpu.engine.aoi import AOIEngine, _CPUBucket, _TPUBucket
+
+    eng = AOIEngine(default_backend="auto", tpu_min_capacity=2048)
+    oracle = AOIEngine(default_backend="cpu")
+    small = eng.create_space(256)
+    big = eng.create_space(4096)
+    assert small.backend == "cpp" and small.requested == "auto"
+    assert isinstance(small.bucket, _CPUBucket)
+    assert big.backend == "tpu" and isinstance(big.bucket, _TPUBucket)
+
+    # parity through both routes in ONE engine
+    rng = np.random.default_rng(21)
+    n_s, n_b = 120, 500
+    xs = rng.uniform(0, 500, n_s).astype(np.float32)
+    xb = rng.uniform(0, 2000, n_b).astype(np.float32)
+    rs = np.full(n_s, 60, np.float32)
+    rb = np.full(n_b, 90, np.float32)
+    acts = np.ones(n_s, bool)
+    actb = np.ones(n_b, bool)
+    os_, ob = oracle.create_space(256), oracle.create_space(4096)
+    for t in range(2):
+        xs = np.clip(xs + rng.uniform(-25, 25, n_s).astype(np.float32),
+                     0, 500)
+        xb = np.clip(xb + rng.uniform(-25, 25, n_b).astype(np.float32),
+                     0, 2000)
+        for e, hs, hb in ((eng, small, big), (oracle, os_, ob)):
+            e.submit(hs, xs, xs, rs, acts)
+            e.submit(hb, xb, xb, rb, actb)
+            e.flush()
+        for h, oh in ((small, os_), (big, ob)):
+            me, ml = eng.take_events(h)
+            ce, cl = oracle.take_events(oh)
+            np.testing.assert_array_equal(me, ce)
+            np.testing.assert_array_equal(ml, cl)
+
+    # growth across the threshold: cpp -> tpu, interests carried silently
+    g = eng.grow_space(small, 2048)
+    og = oracle.grow_space(os_, 2048)
+    assert g.backend == "tpu" and isinstance(g.bucket, _TPUBucket)
+    eng.submit(g, xs, xs, rs, acts)
+    oracle.submit(og, xs, xs, rs, acts)
+    eng.flush(); oracle.flush()
+    me, ml = eng.take_events(g)
+    ce, cl = oracle.take_events(og)
+    np.testing.assert_array_equal(me, ce)  # growth itself emitted nothing
+    np.testing.assert_array_equal(ml, cl)
+
+
+def test_pipelined_midtick_harvest_preserves_pending_events():
+    """grow_space inside an AOI hook (get_prev -> flush) harvests the
+    in-flight tick while OTHER spaces' prior-tick events are still
+    undelivered; the harvest must append to their pending events, never
+    clobber them."""
+    from goworld_tpu.engine.aoi import AOIEngine
+
+    eng = AOIEngine(default_backend="tpu", pipeline=True)
+    a = eng.create_space(128)
+    b = eng.create_space(128)
+    x = np.array([0.0, 5.0], np.float32)
+    r = np.full(2, 50, np.float32)
+    act = np.ones(2, bool)
+    for h in (a, b):
+        eng.submit(h, x, x, r, act)
+    eng.flush()  # tick 1 in flight (the enter pairs)
+    for h in (a, b):
+        eng.submit(h, x, x, r, act)
+    eng.flush()  # publishes tick 1's events for both spaces
+    assert len(eng.take_events(a)[0]) == 2
+    # simulating an on_enter_aoi hook: grow A BEFORE B's events are taken;
+    # the forced harvest of tick 2 (zero diff) must not erase B's batch
+    eng.grow_space(a, 256)
+    eb, _ = eng.take_events(b)
+    assert len(eb) == 2, "pending events clobbered by mid-dispatch harvest"
