@@ -747,12 +747,22 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
     overflow = int(np.sum((stats[:, 2] > mc) | (stats[:, 3] > kcap)))
     enc_overflow = int(np.sum((stats[:, 4] > MAX_GAPS)
                               | (stats[:, 5] > MAX_EXC)))
+    # the recorded rate for device-cadence configs is the CHIP rate -- the
+    # MARGINAL per-tick cost (fixed dispatch/sync and tunnel H2D cancelled;
+    # a colocated deployment pays PCIe + microsecond dispatch for those).
+    # The full-drain wall backs it up when weather inverts the marginal.
+    # The stats-loop wall, which rides the harness tunnel for every byte,
+    # is kept as host_loop_ms_per_tick: round-4 runs recorded the same
+    # chip at 0.06M and 4.9M moves/s purely on tunnel weather, which
+    # measures the wire, not the work.
+    chip_s_tick = (t_device / ticks if not degenerate and t_device > 0
+                   else t_device_wall / ticks)
     return {
-        "moves_per_sec": cfg.moves_per_tick * ticks / dt,
+        "moves_per_sec": cfg.moves_per_tick / chip_s_tick,
         "events_per_tick": float(np.mean(stats[:, 1])),
-        "ms_per_tick": dt / ticks * 1e3,
+        "ms_per_tick": t_device_wall / ticks * 1e3,
+        "host_loop_ms_per_tick": dt / ticks * 1e3,
         "device_ms_per_tick": t_device / ticks * 1e3,
-        "device_wall_ms_per_tick": t_device_wall / ticks * 1e3,
         "device_marginal_degenerate": degenerate,
         "overflow_ticks": overflow,
         "slow_path_ticks": enc_overflow,
@@ -1102,21 +1112,25 @@ def run_config(cfg, companion=False):
         # full-drain time with pre-staged inputs, still harness-colored
         "tpu_device_ms_per_tick": round(tpu["device_ms_per_tick"], 2),
         "tpu_device_wall_ms_per_tick": round(
-            tpu["device_wall_ms_per_tick"], 2),
+            tpu.get("device_wall_ms_per_tick", tpu["ms_per_tick"]), 2),
         "device_marginal_degenerate": tpu["device_marginal_degenerate"],
-        "device_moves_per_sec": round(
-            cfg.moves_per_tick / max(tpu["device_ms_per_tick"], 1e-3) * 1e3),
+        "device_moves_per_sec": (
+            None if tpu["device_marginal_degenerate"] else round(
+                cfg.moves_per_tick
+                / max(tpu["device_ms_per_tick"], 1e-3) * 1e3)),
         "cpu_baseline_moves_per_sec": round(cpu),
         "events_per_tick": round(tpu["events_per_tick"]),
         "overflow_ticks": tpu["overflow_ticks"],
         "slow_path_ticks": tpu["slow_path_ticks"],
         "slice_rows": tpu["slice_rows"],
         "exc_ship": tpu["exc_ship"],
-        "pair_tests_per_sec": round(
-            pair_tests / max(tpu["device_ms_per_tick"], 1e-3) * 1e3),
+        "pair_tests_per_sec": (
+            None if tpu["device_marginal_degenerate"] else round(
+                pair_tests / max(tpu["device_ms_per_tick"], 1e-3) * 1e3)),
     }
     for k in ("mode", "parity_checksum", "parity_ok",
-              "device_cadence_moves_per_sec", "device_cadence_ms_per_tick"):
+              "device_cadence_moves_per_sec", "device_cadence_ms_per_tick",
+              "host_loop_ms_per_tick"):
         if k in tpu:
             out[k] = tpu[k]
     return out
