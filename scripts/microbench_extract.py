@@ -1,12 +1,15 @@
 """On-chip attribution of the extraction pipeline (run on the real TPU).
 
-The sentinel shows the dense kernel is ~0.4-1.3 ms/tick at 8x8192 while the
-full device tick is ~13.6 ms: extraction + encode are ~90% of device time.
-This script times each candidate stage in isolation with chained iterations
-(outputs folded into a consumed scalar so nothing DCEs) to find where the
-milliseconds actually go, and races jax.lax.top_k against a scatter-based
-compaction for the dirty-chunk selection at both the headline and
-million-scale chunk counts.
+CAVEAT (round-4 finding, see CHANGES_r04.md "Measured"): the timings below
+use block_until_ready around a single chained call, which still includes
+one tunnel dispatch+sync of fixed cost (~30-120 ms) amortized over ITERS
+-- treat per-iter numbers as upper bounds, and for decisions re-measure
+the finalists as MARGINALS over two chain lengths (the difference cancels
+every fixed cost; bench.py's sentinel and drains now do exactly this).
+Conclusions that survived marginal re-measurement: the kernel dominates
+device time at both shapes; extraction+encode is ~1 ms at 8x8192 and
+~15 ms at million scale; top_k vs scatter vs hierarchical compaction all
+drown in per-step overhead differences smaller than tunnel noise.
 """
 
 import time
