@@ -333,15 +333,40 @@ class GameService:
             gwutils.run_panicless(self.nil_space.call, method, *args, logger=self.log)
 
     def _h_sync_from_client(self, pkt):
+        """Client position syncs arrive as one flat packet per gate flush;
+        decode straight into the bulk per-space apply
+        (Space.sync_entities_from_client) so the production ingest shape is
+        batched -- per-entity set_position stays for AI/logic moves
+        (reference: GameService.go:398-410 flat array decode)."""
+        ents = self.rt.entities
+        groups: dict = {}  # space -> ([slots], [xs], [ys], [zs], [yaws])
         while pkt.remaining() > 0:
             eid = pkt.read_entity_id()
             x = pkt.read_f32()
             y = pkt.read_f32()
             z = pkt.read_f32()
             yaw = pkt.read_f32()
-            e = self.rt.entities.get(eid)
-            if e is not None:
+            e = ents.get(eid)
+            if e is None or not e.client_syncing:
+                continue
+            sp = e.space
+            if sp is None:
+                continue
+            if e.aoi_slot < 0:
+                # not in the AOI arrays (mid-enter): the per-entity path
+                # still records the position
                 e.sync_position_yaw_from_client(Vector3(x, y, z), yaw)
+                continue
+            g = groups.get(sp)
+            if g is None:
+                g = groups[sp] = ([], [], [], [], [])
+            g[0].append(e.aoi_slot)
+            g[1].append(x)
+            g[2].append(y)
+            g[3].append(z)
+            g[4].append(yaw)
+        for sp, (slots, xs, ys, zs, yaws) in groups.items():
+            sp.sync_entities_from_client(slots, xs, ys, zs, yaws)
 
     def _h_create_entity_anywhere(self, pkt):
         eid = pkt.read_entity_id()

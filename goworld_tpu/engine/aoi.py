@@ -122,11 +122,17 @@ def _fused_bucket_step(prev_all, *args):
             static_argnames=("max_chunks", "kcap", "max_gaps", "max_exc"),
             donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         def impl(prev_all, new_buf, chg_buf, vals_buf, nv_buf, lane_buf,
-                 csel_buf, slot_idx, x, z, r, act, max_chunks, kcap,
+                 csel_buf, slot_idx, x, z, r, act, sub, max_chunks, kcap,
                  max_gaps, max_exc):
             prev_rows = prev_all[slot_idx]
             new, chg = aoi_step_pallas(x, z, r, act, prev_rows, emit="chg")
             prev_all = prev_all.at[slot_idx].set(new)
+            # subscription mask: slots with no event consumers (all-plain
+            # spaces -- their interest state lives in the packed words,
+            # derived on demand) contribute NOTHING to the change stream,
+            # so the fetch/decode cost scales with subscribed slots only.
+            # ``new`` above is unmasked: prev_all must stay authoritative.
+            chg = jnp.where(sub[:, None, None], chg, jnp.uint32(0))
             vals, nv, lane, csel, ccnt, nd, mcc = EV.extract_chunks(
                 chg, max_chunks, kcap, aux=new, lanes=_LANES)
             enc = EV.encode_row_stream(vals, nv, lane, csel, ccnt,
@@ -362,6 +368,13 @@ class AOIEngine:
         """(enter_pairs, leave_pairs) for this space from the last flush."""
         return h.bucket.take_events(h.slot)
 
+    def set_subscribed(self, h: SpaceAOIHandle, flag: bool) -> None:
+        """Opt a space in/out of the per-tick event stream (see
+        _Bucket.set_subscribed).  Spaces whose entities are all plain opt
+        out: device backends then skip their extraction/fetch/decode
+        entirely and their interest state is derived on demand."""
+        h.bucket.set_subscribed(h.slot, flag)
+
     def clear_entity(self, h: SpaceAOIHandle, entity_slot: int) -> None:
         """Erase one entity's row and column from the space's previous-tick
         interest state.  Called when an entity leaves the space: the runtime
@@ -428,6 +441,15 @@ class _Bucket:
 
     def take_events(self, slot: int):
         return self._events.pop(slot, (np.empty((0, 2), np.int32),) * 2)
+
+    def set_subscribed(self, slot: int, flag: bool) -> None:
+        """Event-stream subscription.  A slot whose space has no event
+        consumers (all entities plain: no client, default hooks) may opt out
+        of the per-tick event stream entirely -- its interest state stays in
+        the packed device words, derived on demand (Space.derive_interests).
+        Default: subscribed.  Host backends ignore this (their events are a
+        free by-product of the sweep); device backends skip the extraction,
+        fetch, and decode for opted-out slots."""
 
     # subclass API
     def _grow_to(self, n_slots: int) -> None:
@@ -570,6 +592,12 @@ class _TPUBucket(_Bucket):
         # seed, then one vectorized XOR of each harvested tick's change
         # stream -- no per-tick fetches
         self._mirror: np.ndarray | None = None
+        # slots opted OUT of the event stream (set_subscribed(False)):
+        # their changes are masked out of the extraction on device, so
+        # their mirror rows go stale -- tracked in _mirror_stale and
+        # refreshed from device on the next peek of that slot
+        self._unsub: set[int] = set()
+        self._mirror_stale: set[int] = set()
         # device-resident copies of rarely-changing staged arrays, keyed by
         # array role; re-uploaded only when the host values change
         self._h2d_cache: dict[str, tuple] = {}
@@ -604,12 +632,20 @@ class _TPUBucket(_Bucket):
 
     def _reset_slot(self, slot: int) -> None:
         self._pending_reset.add(slot)
+        self._unsub.discard(slot)  # subscription is per-occupant; default on
+        self._mirror_stale.discard(slot)  # mirror row is reset to truth below
         if self._mirror is not None:
             # immediate even with a tick in flight: the harvest XOR is
             # epoch-guarded, so a dead epoch's stream can no longer re-plant
             # bits over this reset, and derivations between now and the next
             # flush must already see the slot empty
             self._mirror_apply_now(("reset", slot))
+
+    def set_subscribed(self, slot: int, flag: bool) -> None:
+        if flag:
+            self._unsub.discard(slot)
+        else:
+            self._unsub.add(slot)
 
     def peek_words(self, slot: int) -> np.ndarray:
         """Host mirror of the slot's interest words.  First call seeds the
@@ -628,6 +664,18 @@ class _TPUBucket(_Bucket):
                             if self.prev is None
                             else np.array(self.prev, np.uint32, copy=True,
                                           order="C"))
+        elif slot in self._mirror_stale:
+            # the slot's changes were masked out of the stream while it was
+            # unsubscribed: refresh its rows from the device truth (one
+            # [C, W] slice fetch, on demand -- the whole point is that quiet
+            # plain spaces never pay this unless someone actually asks).
+            # flush() first so pending maintenance (resets/clears) reaches
+            # prev before the read; drain() so the refreshed row and the
+            # delivered events agree.
+            self.flush()
+            self.drain()
+            self._mirror[slot] = np.asarray(self.prev[slot])
+            self._mirror_stale.discard(slot)
         return self._mirror[slot]
 
     def flush(self) -> None:
@@ -716,10 +764,14 @@ class _TPUBucket(_Bucket):
                 jnp.full((mc, self._kcap), -1, jnp.int32),
                 jnp.zeros(mc, jnp.int32),
             )
+        sub = np.fromiter((s not in self._unsub for s in slots),
+                          bool, s_n) if self._unsub else np.ones(s_n, bool)
+        if self._mirror is not None and not sub.all():
+            self._mirror_stale.update(s for s in slots if s in self._unsub)
         out = _fused_bucket_step(
             self.prev, *scratch, slot_idx, jnp.asarray(x), jnp.asarray(z),
-            self._h2d("r", r), self._h2d("act", act), mc, self._kcap,
-            self._max_gaps, self._max_exc
+            self._h2d("r", r), self._h2d("act", act), self._h2d("sub", sub),
+            mc, self._kcap, self._max_gaps, self._max_exc
         )
         (self.prev, new, chg, g_vals, g_nv, g_lane, g_csel,
          rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg, exc_new,
@@ -735,11 +787,13 @@ class _TPUBucket(_Bucket):
             "scalars": scalars,
             "prefetch": None,
         }
-        if self.pipeline:
+        if self.pipeline and sub.any():
             # optimistic prefetch at the recent ticks' observed stream sizes:
             # the D2H rides the wire while the host runs the next tick's
             # logic; the harvest refetches exact slices on a misfit (rare --
-            # sizes move slowly in steady state)
+            # sizes move slowly in steady state).  An all-unsubscribed tick
+            # skips it outright: its stream is empty by construction and the
+            # harvest's nd==0 early-out never fetches.
             ndp = min(mc, self._pred[0])
             escp = min(self._max_gaps, self._pred[1])
             excp = min(self._max_exc, self._pred[2])
@@ -785,7 +839,14 @@ class _TPUBucket(_Bucket):
         shrink = self._caps.observe(nd, mcc, self._max_chunks, self._kcap)
         if shrink is not None:
             self._max_chunks, self._kcap = shrink
-        if nd > mc or mcc > kcap:
+        if nd == 0 and exc_n == 0:
+            # quiet tick (or every staged slot unsubscribed): the stream is
+            # empty by construction -- the scalars above are the ONLY fetch
+            chg_vals = np.empty(0, np.uint32)
+            ent_vals = np.empty(0, np.uint32)
+            gidx = np.empty(0, np.int64)
+            self.perf["fetch_s"] += time.perf_counter() - t_f0
+        elif nd > mc or mcc > kcap:
             # caps exceeded: recover this tick from the full diff, then grow
             # the caps so the next tick extracts on device again
             self._max_chunks = max(self._max_chunks, 2 * nd)
@@ -856,6 +917,14 @@ class _TPUBucket(_Bucket):
                     (self._slot_epoch.get(s, 0) for s in slots),
                     np.int64, len(slots))
                 keep = cur[rows] == np.asarray(rec["epochs"], np.int64)[rows]
+                if self._mirror_stale:
+                    # a re-subscribed slot's stream must not XOR onto its
+                    # stale mirror base; the row refreshes from device on
+                    # the next peek instead
+                    stale = np.fromiter(
+                        (s in self._mirror_stale for s in slots),
+                        bool, len(slots))
+                    keep &= ~stale[rows]
                 g, v = (gidx, chg_vals) if keep.all() else (gidx[keep],
                                                            chg_vals[keep])
                 srows = np.asarray(slots, np.int64)[g // wps]
@@ -949,6 +1018,7 @@ class _TPUBucket(_Bucket):
         self.flush()
         self._pending_reset.discard(slot)
         self.prev = self.prev.at[slot].set(self._jnp.asarray(words, self._jnp.uint32))
+        self._mirror_stale.discard(slot)  # mirror row set to truth below
         if self._mirror is not None:
             self._mirror[slot] = np.asarray(words, np.uint32)
 

@@ -86,6 +86,13 @@ class _MeshTPUBucket(_Bucket):
         self._hz = np.zeros((0, capacity), np.float32)
         self._hr = np.zeros((0, capacity), np.float32)
         self._hact = np.zeros((0, capacity), bool)
+        # per-slot event-stream subscription (True = extract events); an
+        # all-plain space opts out and its changes never enter the stream
+        self._hsub = np.ones(0, bool)
+        self._unsub: set[int] = set()
+        # mirror rows gone stale because their slot's changes were masked
+        # while unsubscribed; refreshed from device on the next peek
+        self._mirror_stale: set[int] = set()
         self._pending_reset: set[int] = set()
         self._pending_clear: list[tuple[int, int]] = []
         # slots seeded via set_prev that have not been staged since (see
@@ -149,6 +156,9 @@ class _MeshTPUBucket(_Bucket):
         hact = np.zeros((new_s, self.capacity), bool)
         hact[: self._hact.shape[0]] = self._hact
         self._hact = hact
+        hsub = np.ones(new_s, bool)
+        hsub[: self._hsub.shape[0]] = self._hsub
+        self._hsub = hsub
         # device prev: host round-trip (growth is rare; doubling amortizes)
         prev_h = np.zeros((new_s, self.capacity, self.W), np.uint32)
         if self.prev is not None and self.s_max > 0:
@@ -172,12 +182,27 @@ class _MeshTPUBucket(_Bucket):
         self._hr[slot] = 0.0
         self._hact[slot] = False
         self._seeded_unstaged.discard(slot)
+        self._unsub.discard(slot)  # subscription is per-occupant; default on
+        self._hsub[slot] = True
+        self._mirror_stale.discard(slot)  # mirror row reset to truth below
         if self._mirror is not None:
             self._mirror[slot] = 0
 
     def release_slot(self, slot: int) -> None:
         self._slot_epoch[slot] = self._slot_epoch.get(slot, 0) + 1
+        # a slot seeded via set_prev but released before ever being staged
+        # must not trip the seeded-but-unstaged check at the next flush --
+        # it is dead, not mis-staged
+        self._seeded_unstaged.discard(slot)
         super().release_slot(slot)
+
+    def set_subscribed(self, slot: int, flag: bool) -> None:
+        if flag:
+            self._unsub.discard(slot)
+        else:
+            self._unsub.add(slot)
+        if slot < self._hsub.shape[0]:
+            self._hsub[slot] = flag
 
     def peek_words(self, slot: int) -> np.ndarray:
         if self._mirror is None:
@@ -192,6 +217,13 @@ class _MeshTPUBucket(_Bucket):
                                           order="C"))
             if self.prev is not None:
                 self.full_roundtrips += 1  # one-time mirror seed
+        elif slot in self._mirror_stale:
+            # changes were masked while unsubscribed: refresh this slot's
+            # rows from device truth (one [C, W] slice, on demand)
+            self.flush()
+            self.drain()
+            self._mirror[slot] = np.asarray(self.prev[slot])
+            self._mirror_stale.discard(slot)
         return self._mirror[slot]
 
     # -- state carry-over (growth / freeze-restore) ------------------------
@@ -209,6 +241,7 @@ class _MeshTPUBucket(_Bucket):
                                         np.int32(slot),
                                         words)
         self._seeded_unstaged.add(slot)
+        self._mirror_stale.discard(slot)  # mirror row set to truth below
         if self._mirror is not None:
             self._mirror[slot] = words
 
@@ -352,9 +385,13 @@ class _MeshTPUBucket(_Bucket):
         mg, mx = self._max_gaps, self._max_exc
 
         def _local(prev, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
-                   x, z, r, act):
+                   x, z, r, act, sub):
             new, chg = aoi_step_pallas(x, z, r, act, prev, emit="chg",
                                        interpret=interpret)
+            # subscription mask: all-plain spaces contribute nothing to the
+            # event stream (see engine/aoi._fused_bucket_step); ``new`` is
+            # unmasked -- prev stays authoritative
+            chg = jnp.where(sub[:, None, None], chg, jnp.uint32(0))
             vals, nv, lane, csel, ccnt, nd, mcc = EV.extract_chunks(
                 chg, mc, kcap, aux=new, lanes=_LANES)
             (rowb, bitpos, woff, base_row, n_esc, esc_rows, exc_gidx,
@@ -375,7 +412,7 @@ class _MeshTPUBucket(_Bucket):
         local = jax.shard_map(
             _local,
             mesh=self.mesh.mesh,
-            in_specs=(spec,) * 10,
+            in_specs=(spec,) * 11,
             out_specs=(spec,) * 14,
             check_vma=False,
         )
@@ -449,11 +486,15 @@ class _MeshTPUBucket(_Bucket):
                 "spurious mass-leave (stage the space first)"
                 % sorted(self._seeded_unstaged))
 
+        if self._mirror is not None and self._unsub:
+            self._mirror_stale.update(
+                s for s in staged_slots if s in self._unsub)
         put = self.mesh.device_put
         key, scratch = self._get_scratch()
         out = self._sharded_step()(
             self.prev, *scratch, put(self._hx), put(self._hz),
-            self._h2d("r", self._hr), self._h2d("act", self._hact))
+            self._h2d("r", self._hr), self._h2d("act", self._hact),
+            self._h2d("sub", self._hsub))
         (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos,
          woff, esc_rows, exc_gidx, exc_chg, exc_new, scalars) = out
         self.prev = new  # the step's new words ARE next tick's prev
@@ -470,9 +511,14 @@ class _MeshTPUBucket(_Bucket):
             "scalars": scalars,
             "prefetch": None,
         }
-        if self.pipeline:
+        if self.pipeline and (not self._unsub
+                              or any(s not in self._unsub
+                                     for s in staged_slots)):
             # optimistic per-chip prefetch at recently observed stream
-            # sizes; the harvest refetches exact slices on a misfit
+            # sizes; the harvest refetches exact slices on a misfit (an
+            # all-unsubscribed tick's stream is empty by construction --
+            # skip the prefetch outright, the per-chip nd==0 early-out
+            # never fetches)
             mc = self._max_chunks
             ndp = min(mc, self._pred[0])
             escp = min(self._max_gaps, self._pred[1])
@@ -615,6 +661,13 @@ class _MeshTPUBucket(_Bucket):
                 # epoch guard: a slot released since dispatch had its mirror
                 # reset at re-acquire; the dead stream must not XOR back in
                 keep = live[gx // (c * self.W)]
+                if self._mirror_stale:
+                    # a re-subscribed slot's stream must not XOR onto its
+                    # stale mirror base; the row refreshes from device on
+                    # the next peek instead
+                    stale = np.zeros(self.s_max, bool)
+                    stale[list(self._mirror_stale)] = True
+                    keep &= ~stale[gx // (c * self.W)]
                 if not keep.all():
                     gx, cv = gx[keep], cv[keep]
                 self._mirror.reshape(-1)[gx] ^= cv
@@ -647,6 +700,10 @@ class _MeshTPUBucket(_Bucket):
                 e = np.concatenate([pend[0], e])
                 l = np.concatenate([pend[1], l])
             self._events[slot] = (e, l)
-        # the harvested scratch returns to the pool for reuse
-        self._scratch.setdefault(rec["key"], rec["scratch"])
+        # the harvested scratch returns to the pool for reuse -- but only
+        # while its shape key is still current: after a grow/shrink cleared
+        # the pool, a stale-keyed set can never match _get_scratch again and
+        # would pin a full [S,C,W] chg buffer in device memory indefinitely
+        if rec["key"] == (self.s_max, self._max_chunks, self._kcap):
+            self._scratch.setdefault(rec["key"], rec["scratch"])
         self.perf["decode_s"] += time.perf_counter() - t0

@@ -67,6 +67,10 @@ class Space(Entity):
         self._free_cooling2: list[int] = []
         self._slot_watermark = 0
         self._aoi_dirty = False
+        # event-stream subscription last applied to the calculator: a space
+        # with no nonplain entity opts out (set_subscribed) so device
+        # backends skip its extraction/fetch/decode entirely
+        self._aoi_subscribed = True
 
     @property
     def is_space(self) -> bool:
@@ -197,7 +201,7 @@ class Space(Entity):
         self.on_entity_leave_space(e)
         e.on_leave_space(self)
 
-    def move_entities(self, slots, xs, zs):
+    def move_entities(self, slots, xs, zs, ys=None, yaws=None):
         """Batched position update: one call moves many entities (reference
         analog: the gate->game client-sync path decodes a flat array of
         positions and applies them in one pass, GameService.go:398-410).
@@ -205,28 +209,63 @@ class Space(Entity):
         mutated IN PLACE (no allocation) and sync bookkeeping runs just for
         entities some client can actually see.  This is the device-cadence
         movement path: at 64k entities it costs ~20 ms where per-entity
-        set_position costs ~100 ms."""
+        set_position costs ~100 ms.
+
+        With ``ys``/``yaws`` (the client-sync ingest,
+        sync_entities_from_client) height and yaw update too; the two loops
+        differ ONLY in those extra writes -- keep the bookkeeping block
+        identical (the yaw branch stays out of the hot server-move loop)."""
         slots = np.asarray(slots, np.int64)
         self._x[slots] = xs
         self._z[slots] = zs
         self._aoi_dirty = True
         se = self._slot_np
-        for s, x, z in zip(slots.tolist(), np.asarray(xs).tolist(),
-                           np.asarray(zs).tolist()):
-            e = se[s]
-            if e is None:
-                continue
-            p = e.position
-            p.x = x
-            p.z = z
-            if e._watcher_clients > 0 or e.client is not None:
-                # client-driven entities get no owner echo (same rule as
-                # set_position: correcting the owner fights client-side
-                # prediction); server-driven ones do
-                e._sync_flags |= 2 if e.client_syncing else 3
-                ds = e._dirty_set
-                if ds is not None:
-                    ds.add(e)
+        # two loop bodies, same skeleton: the position writes differ, the
+        # trailing sync-bookkeeping block must stay IDENTICAL (client-driven
+        # entities get no owner echo -- same rule as set_position: correcting
+        # the owner fights client-side prediction; server-driven ones do).
+        # Inlined, not a helper: a per-entity call costs ~5 ms at 64k on the
+        # device-cadence path.
+        if ys is None:
+            for s, x, z in zip(slots.tolist(), np.asarray(xs).tolist(),
+                               np.asarray(zs).tolist()):
+                e = se[s]
+                if e is None:
+                    continue
+                p = e.position
+                p.x = x
+                p.z = z
+                if e._watcher_clients > 0 or e.client is not None:
+                    e._sync_flags |= 2 if e.client_syncing else 3
+                    ds = e._dirty_set
+                    if ds is not None:
+                        ds.add(e)
+        else:
+            for s, x, z, y, yaw in zip(slots.tolist(), xs, zs, ys, yaws):
+                e = se[s]
+                if e is None:
+                    continue
+                p = e.position
+                p.x = x
+                p.y = y
+                p.z = z
+                e.yaw = yaw
+                if e._watcher_clients > 0 or e.client is not None:
+                    e._sync_flags |= 2 if e.client_syncing else 3
+                    ds = e._dirty_set
+                    if ds is not None:
+                        ds.add(e)
+
+    def sync_entities_from_client(self, slots, xs, ys, zs, yaws):
+        """Batched client-driven position/yaw sync: the gate->game sync
+        packet decodes into flat arrays and applies in one pass (reference:
+        GameService.go:398-410 decodes the flat sync array;
+        Entity.go:1221-1267 batches the outbound half).  Semantically one
+        ``sync_position_yaw_from_client`` per entry; shares move_entities'
+        apply loop -- the sync-flag policy there already reduces to
+        SYNC_NEIGHBORS-only for client-syncing entities (no owner echo:
+        correcting the owner fights client-side prediction)."""
+        self.move_entities(slots, xs, zs, ys=ys, yaws=yaws)
 
     def move_entity(self, e: Entity, pos: Vector3):
         """Reference: Space.move, Space.go:253-261.  (Entity.set_position
@@ -254,9 +293,16 @@ class Space(Entity):
         """Stage this tick's arrays if anything changed; returns staged?"""
         if self._aoi_handle is None or not self._aoi_dirty:
             return False
-        self._runtime().aoi.submit(
-            self._aoi_handle, self._x, self._z, self._r, self._act
-        )
+        aoi = self._runtime().aoi
+        # subscription tracks "does anyone consume events?": pairs whose
+        # observer is plain are dropped at delivery anyway, so an all-plain
+        # space needs no event stream at all -- the calculator skips its
+        # extraction/fetch/decode and interest state derives on demand
+        sub = bool(self._nonplain[: self._slot_watermark].any())
+        if sub != self._aoi_subscribed:
+            self._aoi_subscribed = sub
+            aoi.set_subscribed(self._aoi_handle, sub)
+        aoi.submit(self._aoi_handle, self._x, self._z, self._r, self._act)
         self._aoi_dirty = False
         return True
 

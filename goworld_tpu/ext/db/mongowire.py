@@ -261,7 +261,13 @@ class MongoWireClient:
         sock.settimeout(30.0)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = sock
-        hello = self._roundtrip({"hello": 1, "$db": "admin"})
+        try:
+            hello = self._roundtrip({"hello": 1, "$db": "admin"})
+        except (ConnectionError, OSError):
+            # handshake died after the socket was assigned: close it here or
+            # the dead fd lingers until the next command's failure path
+            self._close_dead_sock()
+            raise
         if not hello.get("ok"):
             raise MongoWireError(f"handshake rejected: {hello}")
         self.server_info = hello
@@ -292,23 +298,32 @@ class MongoWireClient:
             except (ConnectionError, OSError):
                 # the socket is dead either way: close it before any
                 # reconnect replaces it (fd leak otherwise)
-                if self._sock is not None:
-                    try:
-                        self._sock.close()
-                    except OSError:
-                        pass
-                    self._sock = None
+                self._close_dead_sock()
                 if next(iter(cmd)) not in self._RETRYABLE:
                     raise
                 # one transparent reconnect (the storage service's retry
                 # loop handles longer outages)
                 self._connect()
-                reply = self._roundtrip(doc)
+                try:
+                    reply = self._roundtrip(doc)
+                except (ConnectionError, OSError):
+                    # the retry's fresh socket is just as dead; close it
+                    # too or its fd leaks until the NEXT command fails
+                    self._close_dead_sock()
+                    raise
         if not reply.get("ok"):
             raise MongoWireError(
                 f"command {next(iter(cmd))!r} failed: "
                 f"{reply.get('errmsg', reply)}")
         return reply
+
+    def _close_dead_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
 
     def _roundtrip(self, doc: dict) -> dict:
         if self._sock is None:

@@ -419,7 +419,11 @@ class _Handler(socketserver.BaseRequestHandler):
         types = []
         for i, name in enumerate(names):
             vals = [r[i] for r in rows if r[i] is not None]
-            if vals and all(isinstance(v, bytes) for v in vals):
+            if vals and any(isinstance(v, bytes) for v in vals):
+                # ANY bytes value makes the column BLOB: sqlite columns are
+                # typeless, so a bytes/str mix must not declare VAR_STRING
+                # (the driver would raw.decode('utf-8') the bytes rows); a
+                # real mysqld serves a BLOB column's text rows as bytes too
                 ctype, charset = _TYPE_BLOB, _CHARSET_BINARY
             elif vals and all(isinstance(v, int)
                               and not isinstance(v, bool) for v in vals):

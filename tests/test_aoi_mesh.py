@@ -317,6 +317,31 @@ def test_mesh_pipelined_clear_and_release_epochs():
         assert not b.peek_words(h_new.slot).any()
 
 
+def test_seeded_slot_released_before_staging_does_not_poison_flush():
+    """A slot seeded via set_prev (freeze-restore path) and then released
+    before ever being staged is dead, not mis-staged: the next flush must
+    not raise the seeded-but-unstaged RuntimeError for it."""
+    mesh = make_mesh(8)
+    eng = AOIEngine(default_backend="tpu", mesh=mesh)
+    cap = 256
+    h0 = eng.create_space(cap)
+    h1 = eng.create_space(cap)
+    x = np.array([0.0, 5.0], np.float32)
+    r = np.full(2, 50, np.float32)
+    act = np.ones(2, bool)
+    eng.submit(h0, x, x, r, act)
+    eng.flush()
+    assert eng.take_events(h0)[0].size == 4  # tick-1 enters (0,1),(1,0)
+    words = h0.bucket.get_prev(h0.slot)
+    # restore into h1's slot, then abandon the space before staging it
+    h1.bucket.set_prev(h1.slot, words)
+    eng.release_space(h1)
+    eng.submit(h0, x, x, r, act)
+    eng.flush()  # must not raise
+    e, l = eng.take_events(h0)
+    assert e.size == 0 and l.size == 0  # steady state, no spurious events
+
+
 def test_mesh_cap4096_clear_storm_no_full_roundtrips():
     """Round-3 verdict item 7: maintenance must not round-trip the full
     [S, C, W] interest state.  Cap 4096 with a clear storm; the bucket's
@@ -358,3 +383,45 @@ def test_mesh_cap4096_clear_storm_no_full_roundtrips():
     eng.flush()
     assert h.bucket.full_roundtrips == 0, (
         "full-array host round-trip on the steady-state path")
+
+
+def test_mesh_subscription_masks_stream_and_peek_refreshes():
+    """Subscription-aware event fetch on the mesh: unsubscribed slots emit
+    no events, their device state keeps evolving, peek refreshes the stale
+    mirror, and re-subscribing resumes exact parity."""
+    mesh = make_mesh(8)
+    eng = AOIEngine(default_backend="tpu", mesh=mesh)
+    oracle = AOIEngine(default_backend="cpu")
+    cap, n, spaces, ticks = 1024, 700, 8, 4
+    scenarios = [walk(s, cap, n, ticks) for s in range(spaces)]
+    hs = [eng.create_space(cap) for _ in range(spaces)]
+    ohs = [oracle.create_space(cap) for _ in range(spaces)]
+    b = hs[0].bucket
+    b.peek_words(hs[0].slot)  # enable the mirror
+    for h in hs[::2]:  # half the spaces opt out
+        eng.set_subscribed(h, False)
+    for t in range(ticks):
+        if t == 3:
+            eng.set_subscribed(hs[0], True)  # re-subscribe one mid-run
+        for h, sc in zip(hs, scenarios):
+            x, z, r, act = sc[t]
+            eng.submit(h, x, z, r, act)
+        for oh, sc in zip(ohs, scenarios):
+            x, z, r, act = sc[t]
+            oracle.submit(oh, x, z, r, act)
+        eng.flush(); oracle.flush()
+        for s, (h, oh) in enumerate(zip(hs, ohs)):
+            me, ml = eng.take_events(h)
+            ce, cl = oracle.take_events(oh)
+            unsub = (s % 2 == 0) and not (s == 0 and t >= 3)
+            if unsub:
+                assert me.size == 0 and ml.size == 0, (
+                    f"unsubscribed slot leaked events t={t} s={s}")
+            else:
+                np.testing.assert_array_equal(me, ce, err_msg=f"t={t} s={s}")
+                np.testing.assert_array_equal(ml, cl, err_msg=f"t={t} s={s}")
+    # stale mirrors refresh from device, bit-exact vs the oracle
+    for s in (0, 2, 4):
+        np.testing.assert_array_equal(
+            hs[s].bucket.peek_words(hs[s].slot),
+            ohs[s].bucket.peek_words(ohs[s].slot), err_msg=f"peek s={s}")

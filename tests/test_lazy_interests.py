@@ -208,3 +208,47 @@ def test_pipelined_mirror_reset_on_slot_reuse():
     assert not words.any(), (
         "dead epoch's stream XORed into the reused slot's mirror: %r"
         % words[words != 0])
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_all_plain_space_unsubscribes_from_event_stream(backend):
+    """Round-4 verdict item 1b, engine-integrated: a space whose entities
+    are all plain opts out of the calculator's event stream (device
+    backends then skip its extraction/fetch/decode); interest state still
+    derives correctly, and a client entering re-subscribes the space so
+    eager replay resumes."""
+    rt, sp = build(backend)
+    a = rt.entities.create("Mob", space=sp, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Mob", space=sp, pos=Vector3(10, 0, 10))
+    rt.tick()
+    h = sp._aoi_handle
+    # all-plain -> unsubscribed at the bucket (cpu backends accept the call
+    # and ignore it; the tpu bucket masks the slot out of the stream)
+    assert not sp._aoi_subscribed
+    if backend == "tpu":
+        assert h.slot in h.bucket._unsub
+    # derivation still exact while unsubscribed
+    b.set_position(Vector3(5, 0, 5))
+    rt.tick()
+    assert set(a.neighbors()) == {b}
+    assert set(b.neighbors()) == {a}
+
+    # a client attaches: materialize + re-subscribe; eager replay resumes
+    cli = GameClient("c1")
+    a.set_client(cli)
+    assert a.interested_in == {b}
+    c = rt.entities.create("Mob", space=sp, pos=Vector3(8, 0, 8))
+    rt.tick()
+    assert sp._aoi_subscribed
+    if backend == "tpu":
+        assert h.slot not in h.bucket._unsub
+    assert c in a.interested_in, "event replay dead after re-subscribe"
+    assert any(op[0] == "create_entity" and op[2] == c.id
+               for op in cli.outbox)
+
+    # client detaches: space returns to packed-only and opts back out
+    a.set_client(None)
+    c.set_position(Vector3(400, 0, 400))
+    rt.tick()
+    assert not sp._aoi_subscribed
+    assert set(a.neighbors()) == {b}

@@ -64,6 +64,24 @@ def test_wire_client_query_roundtrip(server):
     c.close()
 
 
+def test_mixed_bytes_str_column_decodes_as_blob(server):
+    # sqlite columns are typeless: one column can hold both bytes and str
+    # rows.  The server must declare it BLOB (ANY bytes value wins) so the
+    # driver returns bytes for every row instead of raising
+    # UnicodeDecodeError on the binary ones.
+    c = MySQLWireClient(port=server.port)
+    cur = c.cursor()
+    cur.execute("CREATE TABLE IF NOT EXISTS mixed (k TEXT, v BLOB)")
+    cur.execute("REPLACE INTO mixed (k, v) VALUES (%s, %s)", ("a", b"\xff\x00"))
+    with server._srv.db_lock:
+        server._srv.db.execute(
+            "INSERT INTO mixed (k, v) VALUES ('b', 'plain-text')")
+    cur.execute("SELECT v FROM mixed ORDER BY k")
+    rows = [r[0] for r in cur.fetchall()]
+    assert rows == [b"\xff\x00", b"plain-text"]
+    c.close()
+
+
 def test_mysql_entity_storage_over_wire(server):
     from goworld_tpu.storage.backends import MySQLEntityStorage
 
