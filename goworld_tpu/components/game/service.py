@@ -53,6 +53,7 @@ class GameService:
             aoi_mesh=self.gcfg.aoi_mesh_devices or None,
             aoi_pipeline=self.gcfg.aoi_pipeline,
             aoi_tpu_min_capacity=self.gcfg.aoi_tpu_min_capacity,
+            aoi_rowshard_min_capacity=self.gcfg.aoi_rowshard_min_capacity,
         )
         self.rt.on_entity_registered = self._on_entity_registered
         self.rt.on_entity_unregistered = self._on_entity_unregistered

@@ -51,6 +51,10 @@ class GameConfig:
     # microseconds; a 8k+ space is the reverse)
     aoi_backend: str = "cpu"
     aoi_tpu_min_capacity: int = 4096
+    # with a mesh: a single space at or above this capacity shards its
+    # interest ROWS over the chips (engine/aoi_rowshard -- the oversized-
+    # hot-space answer); below it, spaces shard whole
+    aoi_rowshard_min_capacity: int = 65536
     # >0 with aoi_backend=tpu/auto: shard every tpu bucket's spaces over an
     # N-device mesh (engine/aoi_mesh); 0 = single device
     aoi_mesh_devices: int = 0

@@ -225,7 +225,8 @@ class AOIEngine:
 
     def __init__(self, default_backend: str = "cpu",
                  oracle_algorithm: str = "sweep", mesh=None,
-                 pipeline: bool = False, tpu_min_capacity: int = 4096):
+                 pipeline: bool = False, tpu_min_capacity: int = 4096,
+                 rowshard_min_capacity: int = 65536):
         self.default_backend = default_backend
         self.oracle_algorithm = oracle_algorithm
         # "auto" routing threshold: spaces below it go to the native host
@@ -233,6 +234,12 @@ class AOIEngine:
         # the native sweep finishes in microseconds), larger ones to the
         # tpu bucket where the batched kernel wins
         self.tpu_min_capacity = tpu_min_capacity
+        # oversized-single-space threshold: with a mesh, a space at or above
+        # this capacity shards its interest ROWS over the chips (each chip
+        # owns C/n observers vs all C candidates -- engine/aoi_rowshard)
+        # instead of living whole on one chip.  The zipf100k scaling answer.
+        self.rowshard_min_capacity = rowshard_min_capacity
+        self._rowshard_serial = 0
         if isinstance(mesh, int):
             from ..parallel import SpaceMesh, multichip_devices
 
@@ -299,8 +306,11 @@ class AOIEngine:
             # large ones belong on the batched kernel
             backend = ("tpu" if capacity >= self.tpu_min_capacity
                        else "cpp")
+        rowshard = (backend == "tpu" and self.mesh is not None
+                    and capacity >= self.rowshard_min_capacity
+                    and capacity % (self.mesh.n_devices * 128) == 0)
         key = (backend, capacity)
-        bucket = self._buckets.get(key)
+        bucket = None if rowshard else self._buckets.get(key)
         if bucket is None:
             if backend == "cpu":
                 bucket = _CPUBucket(capacity, self.oracle_algorithm)
@@ -324,7 +334,18 @@ class AOIEngine:
                     )
                     bucket = _CPUBucket(capacity, self.oracle_algorithm)
             elif backend == "tpu":
-                if self.mesh is not None:
+                if rowshard:
+                    # oversized single space: shard its interest rows over
+                    # the mesh; one EXCLUSIVE bucket per space (at C=131072
+                    # the packed state is 2 GB mesh-wide -- released with
+                    # the space, never pooled)
+                    from .aoi_rowshard import _RowShardTPUBucket
+
+                    bucket = _RowShardTPUBucket(capacity, self.mesh,
+                                                pipeline=self.pipeline)
+                    self._rowshard_serial += 1
+                    key = (f"tpu-rowshard-{self._rowshard_serial}", capacity)
+                elif self.mesh is not None:
                     from .aoi_mesh import _MeshTPUBucket
 
                     bucket = _MeshTPUBucket(capacity, self.mesh,
@@ -342,6 +363,12 @@ class AOIEngine:
         if not h.released:
             h.bucket.release_slot(h.slot)
             h.released = True
+            if getattr(h.bucket, "exclusive", False):
+                # per-space bucket (row-sharded): drop it so its device
+                # state frees with the space
+                for k, b in list(self._buckets.items()):
+                    if b is h.bucket:
+                        del self._buckets[k]
 
     def submit(self, h: SpaceAOIHandle, x, z, radius, active) -> None:
         """Stage one space's tick inputs (numpy arrays of length <= capacity)."""
@@ -395,11 +422,26 @@ class AOIEngine:
         if new_capacity <= h.capacity:
             raise ValueError("grow_space requires a larger capacity")
         old_words = h.bucket.get_prev(h.slot)
-        m = P.unpack_rows(old_words, h.capacity)
-        grown = np.zeros((new_capacity, new_capacity), bool)
-        grown[: h.capacity, : h.capacity] = m
+        ratio = new_capacity // h.capacity
+        if new_capacity == h.capacity * ratio and ratio & (ratio - 1) == 0:
+            # power-of-two growth (every Space growth: capacity doubles):
+            # packed word-level column remap, no dense matrix -- the dense
+            # path is O(C^2) host BYTES, 17 GB at C=131072 (the oversized
+            # capacities the row-sharded calculator serves)
+            cap = h.capacity
+            words = old_words
+            while cap < new_capacity:
+                words = P.repack_columns_double(words, cap)
+                cap *= 2
+            packed = np.zeros((new_capacity, words.shape[1]), np.uint32)
+            packed[: h.capacity] = words
+        else:
+            m = P.unpack_rows(old_words, h.capacity)
+            grown = np.zeros((new_capacity, new_capacity), bool)
+            grown[: h.capacity, : h.capacity] = m
+            packed = P.pack_rows(grown)
         nh = self.create_space(new_capacity, h.requested or h.backend)
-        nh.bucket.set_prev(nh.slot, P.pack_rows(grown))
+        nh.bucket.set_prev(nh.slot, packed)
         # carry undelivered events: growth can happen between flush() and
         # dispatch_aoi_events() (e.g. an on_enter_aoi hook spawns entities);
         # dropping them would permanently desync interest sets

@@ -39,6 +39,7 @@ class Runtime:
         aoi_mesh=None,
         aoi_pipeline: bool = False,
         aoi_tpu_min_capacity: int = 4096,
+        aoi_rowshard_min_capacity: int = 65536,
     ):
         self.now = now
         self.on_error = on_error or self._default_on_error
@@ -47,7 +48,8 @@ class Runtime:
         self.crontab = Crontab()
         self.aoi = AOIEngine(default_backend=aoi_backend, mesh=aoi_mesh,
                              pipeline=aoi_pipeline,
-                             tpu_min_capacity=aoi_tpu_min_capacity)
+                             tpu_min_capacity=aoi_tpu_min_capacity,
+                             rowshard_min_capacity=aoi_rowshard_min_capacity)
         self.entities = EntityManager(self)
         self.tick_count = 0
         # entities with pending sync flags / attr deltas / quiet countdowns;

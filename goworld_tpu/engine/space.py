@@ -90,15 +90,21 @@ class Space(Entity):
         pass
 
     # -- AOI management ----------------------------------------------------
-    def enable_aoi(self, default_dist: float, backend: str | None = None):
+    def enable_aoi(self, default_dist: float, backend: str | None = None,
+                   capacity: int | None = None):
         """Turn on interest management for this space (reference:
-        EnableAOI, Space.go:91-107).  Must be called before entities enter."""
+        EnableAOI, Space.go:91-107).  Must be called before entities enter.
+
+        ``capacity`` pre-sizes the space: population grows capacity on
+        demand anyway, but an expected-oversized space (>= the row-shard
+        threshold) should pre-size so it lands on the row-sharded
+        calculator directly instead of repacking through every doubling."""
         if self._aoi_handle is not None:
             raise RuntimeError("AOI already enabled")
         if self.entities:
             raise RuntimeError("enable AOI before entities enter the space")
         self._aoi_default_dist = float(default_dist)
-        self._ensure_capacity(_MIN_CAPACITY)
+        self._ensure_capacity(max(_MIN_CAPACITY, int(capacity or 0)))
         self._aoi_handle = self._runtime().aoi.create_space(self._cap, backend)
 
     @property
@@ -132,6 +138,10 @@ class Space(Entity):
             self._aoi_handle = self._runtime().aoi.grow_space(
                 self._aoi_handle, new_cap
             )
+            # the fresh bucket slot defaults to subscribed; reset the cached
+            # flag so the next submit re-applies an unsubscription (an
+            # all-plain space must not silently resume event extraction)
+            self._aoi_subscribed = True
 
     # -- membership --------------------------------------------------------
     def enter_entity(self, e: Entity, pos: Vector3, is_restore: bool = False):
@@ -348,10 +358,16 @@ class Space(Entity):
         h = self._aoi_handle
         if h is None or slot < 0:
             return []
-        words = h.bucket.peek_words(h.slot)
-        if words is None:
-            words = h.bucket.get_prev(h.slot)
-        row = words[slot]
+        derive = getattr(h.bucket, "derive_row", None)
+        if derive is not None:
+            # row-sharded oversized space: fetch ONE observer's words [W]
+            # (16 KB) instead of materializing the full [C, W] state
+            row = derive(h.slot, slot)
+        else:
+            words = h.bucket.peek_words(h.slot)
+            if words is None:
+                words = h.bucket.get_prev(h.slot)
+            row = words[slot]
         w_per = row.shape[0]
         sn = self._slot_np
         out = []
@@ -370,13 +386,17 @@ class Space(Entity):
         h = self._aoi_handle
         if h is None or slot < 0:
             return []
-        words = h.bucket.peek_words(h.slot)
-        if words is None:
-            words = h.bucket.get_prev(h.slot)
-        from ..ops import aoi_predicate as AP
+        derive = getattr(h.bucket, "derive_col", None)
+        if derive is not None:
+            rows = derive(h.slot, slot)
+        else:
+            words = h.bucket.peek_words(h.slot)
+            if words is None:
+                words = h.bucket.get_prev(h.slot)
+            from ..ops import aoi_predicate as AP
 
-        w, b = AP.word_bit_for_column(slot, self._cap)
-        rows = np.nonzero(words[:, w] & (np.uint32(1) << np.uint32(b)))[0]
+            w, b = AP.word_bit_for_column(slot, self._cap)
+            rows = np.nonzero(words[:, w] & (np.uint32(1) << np.uint32(b)))[0]
         sn = self._slot_np
         return [sn[i] for i in rows if sn[i] is not None]
 

@@ -269,6 +269,9 @@ class MongoWireClient:
             self._close_dead_sock()
             raise
         if not hello.get("ok"):
+            # rejected (auth/version): the half-initialized socket must not
+            # stay assigned -- the next command would happily send on it
+            self._close_dead_sock()
             raise MongoWireError(f"handshake rejected: {hello}")
         self.server_info = hello
 

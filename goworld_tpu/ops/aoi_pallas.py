@@ -51,15 +51,18 @@ from .aoi_predicate import WORD_BITS, words_per_row
 _INF = float("inf")
 
 
-def _mask_block(x_row, z_row, r_row, x_col, z_col, *, ti, col_off=0):
-    bi = pl.program_id(1)
+def _mask_block(x_row, z_row, r_row, rid_row, x_col, z_col, *, ti,
+                col_off=0):
     cb = x_col.shape[-1]
     xr = x_row[0, 0].reshape(ti, 1)
     zr = z_row[0, 0].reshape(ti, 1)
     rr = r_row[0, 0].reshape(ti, 1)
     xc = x_col[0, 0].reshape(1, cb)
     zc = z_col[0, 0].reshape(1, cb)
-    row_ids = bi * ti + jax.lax.broadcasted_iota(jnp.int32, (ti, 1), 0)
+    # GLOBAL observer ids ride an input array (not the grid position): in
+    # rectangular mode (observer-row-sharded space) this block's rows are a
+    # slice of a larger space, so self-exclusion needs the global id
+    row_ids = rid_row[0, 0].reshape(ti, 1)
     col_ids = col_off + jax.lax.broadcasted_iota(jnp.int32, (ti, cb), 1)
     m = (jnp.abs(xc - xr) <= rr) & (jnp.abs(zc - zr) <= rr)
     return m & (row_ids != col_ids)
@@ -79,8 +82,8 @@ def _write_diff(acc, prev, *outs):
         chg_out[0] = accu ^ pw
 
 
-def _aoi_kernel_slicepack(x_row, z_row, r_row, x_col, z_col, prev, *outs,
-                          ti, w, planes):
+def _aoi_kernel_slicepack(x_row, z_row, r_row, rid_row, x_col, z_col,
+                          prev, *outs, ti, w, planes):
     """Pure-VPU pack with column blocking.
 
     Grid (S, C//ti, n_cb): this step sees the column slice
@@ -95,7 +98,8 @@ def _aoi_kernel_slicepack(x_row, z_row, r_row, x_col, z_col, prev, *outs,
     """
     ci = pl.program_id(2)
     m32 = _mask_block(
-        x_row, z_row, r_row, x_col, z_col, ti=ti, col_off=ci * planes * w
+        x_row, z_row, r_row, rid_row, x_col, z_col, ti=ti,
+        col_off=ci * planes * w
     ).astype(jnp.int32)
     part = jnp.zeros((ti, w), jnp.int32)
     for kk in range(planes):
@@ -117,8 +121,8 @@ def _aoi_kernel_slicepack(x_row, z_row, r_row, x_col, z_col, prev, *outs,
         outs[1][0] = acc ^ pw
 
 
-def _aoi_kernel_planewise(x_row, z_row, r_row, x_col, z_col, prev, *outs,
-                          ti, w, wb):
+def _aoi_kernel_planewise(x_row, z_row, r_row, rid_row, x_col, z_col,
+                          prev, *outs, ti, w, wb):
     """Slice-pack for very wide rows (w >= 2048, C >= 64k).
 
     Grid (S, C//ti, w//wb, 32): one step computes ONE bit plane k over the
@@ -131,7 +135,8 @@ def _aoi_kernel_planewise(x_row, z_row, r_row, x_col, z_col, prev, *outs,
     wo = pl.program_id(2)
     k = pl.program_id(3)
     m32 = _mask_block(
-        x_row, z_row, r_row, x_col, z_col, ti=ti, col_off=k * w + wo * wb
+        x_row, z_row, r_row, rid_row, x_col, z_col, ti=ti,
+        col_off=k * w + wo * wb
     ).astype(jnp.int32)
     kbit = jax.lax.shift_left(jnp.int32(1), k)
     partu = jax.lax.bitcast_convert_type(m32 * kbit, jnp.uint32)
@@ -146,9 +151,10 @@ def _aoi_kernel_planewise(x_row, z_row, r_row, x_col, z_col, prev, *outs,
         outs[1][0] = acc ^ pw
 
 
-def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, *outs, ti, w):
+def _aoi_kernel(x_row, z_row, r_row, rid_row, x_col, z_col, prev, *outs,
+                ti, w):
     c = WORD_BITS * w
-    m = _mask_block(x_row, z_row, r_row, x_col, z_col, ti=ti)
+    m = _mask_block(x_row, z_row, r_row, rid_row, x_col, z_col, ti=ti)
     mf = m.astype(jnp.float32)
 
     # Pack on the MXU, one byte plane per matmul (see module docstring).
@@ -167,7 +173,7 @@ def _aoi_kernel(x_row, z_row, r_row, x_col, z_col, prev, *outs, ti, w):
 
 @functools.partial(jax.jit, static_argnames=("block_rows", "interpret", "emit"))
 def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128,
-                    interpret=None, emit="entlv"):
+                    interpret=None, emit="entlv", cols=None, row_ids=None):
     """Batched AOI tick on TPU.
 
     Args: x, z, radius [S, C] f32; active [S, C] bool; prev_words [S, C, W]
@@ -176,16 +182,30 @@ def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128,
     ``changed = new ^ prev`` -- one fewer [S, C, W] HBM write per tick, and
     enter/leave recover exactly as ``chg & new`` / ``chg & ~new``.
     Bit-exact with :func:`aoi_dense.aoi_step_dense` and the CPU oracle.
+
+    RECTANGULAR mode (observer-row-sharded oversized spaces): with
+    ``cols=(x_col, z_col, active_col)`` [S, C_cols] the row arrays are a
+    BLOCK of observers evaluated against all C_cols candidates;
+    ``prev_words`` is then [S, C_rows, W(C_cols)] and ``row_ids``
+    [S, C_rows] int32 must carry the observers' GLOBAL column ids (for
+    self-exclusion).  Each device of a row-sharded mesh calls this with its
+    row block -- no collectives, candidates are replicated at H2D.
     """
-    s, c = x.shape
+    s, c_rows = x.shape
+    if cols is None:
+        x_c, z_c, act_c = x, z, active
+        c = c_rows
+    else:
+        x_c, z_c, act_c = cols
+        c = x_c.shape[-1]
     w = words_per_row(c)
     # Legalize the row-block hint: the row slice rides the lane dim, so a
-    # partial block must be a 128-multiple that divides C; else use full C.
-    ti = min(block_rows, c)
-    if ti != c:
+    # partial block must be a 128-multiple that divides C_rows; else full.
+    ti = min(block_rows, c_rows)
+    if ti != c_rows:
         ti = (ti // 128) * 128
-        if ti == 0 or c % ti != 0:
-            ti = c
+        if ti == 0 or c_rows % ti != 0:
+            ti = c_rows
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -193,11 +213,21 @@ def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128,
     # The [S, 1, C] layout keeps every block's trailing dims either equal to
     # the array dims or lane/sublane aligned -- the Mosaic tiling rule that a
     # 2D [S, C] layout breaks whenever S is not a multiple of 8.
-    x_eff = jnp.where(active, x, jnp.float32(_INF)).reshape(s, 1, c)
-    z_eff = jnp.where(active, z, jnp.float32(_INF)).reshape(s, 1, c)
-    r_eff = jnp.where(active, radius, jnp.float32(-1.0)).reshape(s, 1, c)
+    x_eff = jnp.where(active, x, jnp.float32(_INF)).reshape(s, 1, c_rows)
+    r_eff = jnp.where(active, radius, jnp.float32(-1.0)).reshape(s, 1, c_rows)
+    if cols is None:
+        z_eff = jnp.where(active, z, jnp.float32(_INF)).reshape(s, 1, c)
+        xc_eff, zc_eff = x_eff, z_eff
+    else:
+        z_eff = jnp.where(active, z, jnp.float32(_INF)).reshape(s, 1, c_rows)
+        xc_eff = jnp.where(act_c, x_c, jnp.float32(_INF)).reshape(s, 1, c)
+        zc_eff = jnp.where(act_c, z_c, jnp.float32(_INF)).reshape(s, 1, c)
+    if row_ids is None:
+        row_ids = jnp.broadcast_to(
+            jnp.arange(c_rows, dtype=jnp.int32)[None, :], (s, c_rows))
+    rid = row_ids.astype(jnp.int32).reshape(s, 1, c_rows)
 
-    out_shape = jax.ShapeDtypeStruct((s, c, w), jnp.uint32)
+    out_shape = jax.ShapeDtypeStruct((s, c_rows, w), jnp.uint32)
     n_out = 3 if emit == "entlv" else 2
 
     if w % 2048 == 0:
@@ -210,7 +240,7 @@ def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128,
         words_spec = pl.BlockSpec(
             (1, ti, wb), lambda si, bi, wo, k: (si, bi, wo))
         kernel = functools.partial(_aoi_kernel_planewise, ti=ti, w=w, wb=wb)
-        grid = (s, c // ti, w // wb, WORD_BITS)
+        grid = (s, c_rows // ti, w // wb, WORD_BITS)
     elif w % 128 == 0:
         # Column-blocked slice-pack: cap the mask block at [ti, 8192] so VMEM
         # stays bounded as C grows (a [128, C] mask is 64 MB at C=131072).
@@ -227,18 +257,19 @@ def aoi_step_pallas(x, z, radius, active, prev_words, *, block_rows=128,
         words_spec = pl.BlockSpec((1, ti, w), lambda si, bi, ci: (si, bi, 0))
         kernel = functools.partial(_aoi_kernel_slicepack, ti=ti, w=w,
                                    planes=planes)
-        grid = (s, c // ti, n_cb)
+        grid = (s, c_rows // ti, n_cb)
     else:
         row_spec = pl.BlockSpec((1, 1, ti), lambda si, bi: (si, 0, bi))
         col_spec = pl.BlockSpec((1, 1, c), lambda si, bi: (si, 0, 0))
         words_spec = pl.BlockSpec((1, ti, w), lambda si, bi: (si, bi, 0))
         kernel = functools.partial(_aoi_kernel, ti=ti, w=w)
-        grid = (s, c // ti)
+        grid = (s, c_rows // ti)
     return pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[row_spec, row_spec, row_spec, col_spec, col_spec, words_spec],
+        in_specs=[row_spec, row_spec, row_spec, row_spec, col_spec, col_spec,
+                  words_spec],
         out_specs=(words_spec,) * n_out,
         out_shape=(out_shape,) * n_out,
         interpret=interpret,
-    )(x_eff, z_eff, r_eff, x_eff, z_eff, prev_words)
+    )(x_eff, z_eff, r_eff, rid, xc_eff, zc_eff, prev_words)

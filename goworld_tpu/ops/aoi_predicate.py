@@ -103,6 +103,43 @@ def unpack_rows(words: np.ndarray, capacity: int) -> np.ndarray:
     return planes.reshape(n, capacity).astype(bool)
 
 
+_EVEN = np.uint32(0x55555555)
+_M2 = np.uint32(0x33333333)
+_M4 = np.uint32(0x0F0F0F0F)
+_M8 = np.uint32(0x00FF00FF)
+_M16 = np.uint32(0x0000FFFF)
+
+
+def _compress_even_bits(v: np.ndarray) -> np.ndarray:
+    """Pack the even bits of each uint32 into its low 16 bits (bit 2t ->
+    bit t) -- the classic parallel-compress ladder, vectorized."""
+    v = v & _EVEN
+    v = (v | (v >> np.uint32(1))) & _M2
+    v = (v | (v >> np.uint32(2))) & _M4
+    v = (v | (v >> np.uint32(4))) & _M8
+    v = (v | (v >> np.uint32(8))) & _M16
+    return v
+
+
+def repack_columns_double(words: np.ndarray, old_cap: int) -> np.ndarray:
+    """Remap packed rows [R, W(old_cap)] to the 2*old_cap column layout
+    WITHOUT materializing the dense boolean matrix.
+
+    Planar packing: column j of capacity C lives at (word j % W, bit
+    j // W).  Doubling C keeps j but W2 = 2W, so old (w, k) moves to
+    (w + (k & 1) * W, k >> 1): the even bit-planes of word w compact into
+    word w, the odd ones into word w + W.  Two vectorized compress passes
+    per doubling -- the dense repack is O(C^2) BYTES of host bools, which
+    is 17 GB at C=131072 (grow_space would OOM exactly at the oversized
+    capacities the row-sharded calculator serves)."""
+    r, w_old = words.shape
+    assert w_old == words_per_row(old_cap)
+    out = np.empty((r, 2 * w_old), np.uint32)
+    out[:, :w_old] = _compress_even_bits(words)
+    out[:, w_old:] = _compress_even_bits(words >> np.uint32(1))
+    return out
+
+
 def word_bit_for_column(j: int, capacity: int) -> tuple[int, int]:
     """(word index, bit index) holding column j in the planar layout."""
     w = words_per_row(capacity)

@@ -396,3 +396,38 @@ def test_subscription_all_unsubscribed_pipelined_quiet_fetch():
         np.testing.assert_array_equal(
             b.peek_words(h.slot),
             oh.bucket.peek_words(oh.slot))
+
+
+def test_packed_growth_repack_matches_dense():
+    """grow_space's packed column remap (repack_columns_double) is
+    bit-identical to the dense-matrix path -- and growth through it emits
+    no spurious events (state carried exactly)."""
+    from goworld_tpu.ops import aoi_predicate as P
+
+    rng = np.random.default_rng(11)
+    for cap in (128, 512):
+        m = rng.random((cap, cap)) < 0.05
+        words = P.pack_rows(m)
+        grown = np.zeros((cap, 2 * cap), bool)
+        grown[:, :cap] = m
+        ref = P.pack_rows(np.pad(grown, ((0, cap), (0, 0))))[:cap]
+        np.testing.assert_array_equal(
+            P.repack_columns_double(words, cap), ref)
+    # engine growth (x4 in one call: two chained doublings inside)
+    for backend in ("cpu", "tpu"):
+        eng = AOIEngine(default_backend=backend)
+        cap, n = 128, 100
+        h = eng.create_space(cap)
+        x = np.random.default_rng(1).uniform(0, 300, n).astype(np.float32)
+        r = np.full(n, 60, np.float32)
+        act = np.ones(n, bool)
+        eng.submit(h, x, x, r, act)
+        eng.flush()
+        before = eng.take_events(h)[0]
+        assert len(before) > 0
+        h = eng.grow_space(h, 512)
+        eng.submit(h, np.pad(x, (0, 1)), np.pad(x, (0, 1)),
+                   np.pad(r, (0, 1)), np.pad(act, (0, 1)))
+        eng.flush()
+        e, l = eng.take_events(h)
+        assert len(l) == 0, f"{backend}: growth emitted leaves"

@@ -1,0 +1,242 @@
+"""Observer-row-sharded AOI (engine/aoi_rowshard): ONE oversized space's
+interest rows partitioned over the 8-virtual-device CPU mesh, events
+bit-identical to the single-device CPU oracle.
+
+Round-4 verdict item 2 (the zipf100k gap): spaces shard over chips whole, so
+a single space hotter than one chip's real-time budget had no scaling story.
+These tests run the row-sharded calculator through AOIEngine and Runtime at
+a small capacity (threshold lowered) -- the per-chip production shape is
+covered by the zipfshare bench config.
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.engine.aoi import AOIEngine
+
+
+def make_mesh(n=8):
+    from goworld_tpu.parallel import SpaceMesh, multichip_devices
+
+    devs = multichip_devices(n)
+    if len(devs) < n:
+        pytest.skip(f"need {n} devices")
+    return SpaceMesh(devs)
+
+
+def make_engines(cap=1024, thresh=1024):
+    mesh = make_mesh(8)
+    eng = AOIEngine(default_backend="tpu", mesh=mesh,
+                    rowshard_min_capacity=thresh)
+    oracle = AOIEngine(default_backend="cpu")
+    return eng, oracle
+
+
+def walk(rng, x, z, n, world=1500.0):
+    x = np.clip(x + rng.uniform(-25, 25, n), 0, world).astype(np.float32)
+    z = np.clip(z + rng.uniform(-25, 25, n), 0, world).astype(np.float32)
+    return x, z
+
+
+def test_rowshard_parity_storm_and_state():
+    """Var-radius walk, a clear storm (silent), packed-state bit-equality,
+    and on-demand row/column derivation."""
+    eng, oracle = make_engines()
+    cap, n = 1024, 900
+    h = eng.create_space(cap)
+    from goworld_tpu.engine.aoi_rowshard import _RowShardTPUBucket
+
+    assert isinstance(h.bucket, _RowShardTPUBucket)
+    oh = oracle.create_space(cap)
+    rng = np.random.default_rng(1)
+    x = rng.uniform(0, 1500, n).astype(np.float32)
+    z = rng.uniform(0, 1500, n).astype(np.float32)
+    r = rng.uniform(40, 120, n).astype(np.float32)
+    act = rng.random(n) < 0.95
+    for t in range(4):
+        x, z = walk(rng, x, z, n)
+        eng.submit(h, x, z, r, act)
+        oracle.submit(oh, x, z, r, act)
+        eng.flush(); oracle.flush()
+        e, l = eng.take_events(h)
+        ce, cl = oracle.take_events(oh)
+        np.testing.assert_array_equal(e, ce, err_msg=f"enter t={t}")
+        np.testing.assert_array_equal(l, cl, err_msg=f"leave t={t}")
+
+    # migration storm: clears are silent and maintenance hits the right
+    # rows on EVERY chip (regression: negative local row indices wrapped)
+    gone = rng.choice(n, 120, replace=False)
+    act2 = act.copy()
+    act2[gone] = False
+    for s in gone:
+        eng.clear_entity(h, int(s))
+        oracle.clear_entity(oh, int(s))
+    eng.submit(h, x, z, r, act2)
+    oracle.submit(oh, x, z, r, act2)
+    eng.flush(); oracle.flush()
+    e, l = eng.take_events(h)
+    ce, cl = oracle.take_events(oh)
+    np.testing.assert_array_equal(e, ce)
+    np.testing.assert_array_equal(l, cl)
+    assert len(l) == 0
+
+    ow = oracle._buckets[("cpu", cap)]._oracles[oh.slot].prev_words
+    np.testing.assert_array_equal(h.bucket.get_prev(h.slot), ow)
+    np.testing.assert_array_equal(h.bucket.derive_row(h.slot, 5), ow[5])
+    from goworld_tpu.ops import aoi_predicate as P
+
+    w, b = P.word_bit_for_column(7, cap)
+    np.testing.assert_array_equal(
+        h.bucket.derive_col(h.slot, 7), np.nonzero(ow[:, w] & (1 << b))[0])
+
+    # release drops the exclusive bucket (2 GB of device state in prod)
+    eng.release_space(h)
+    assert not any(getattr(b, "exclusive", False)
+                   for b in eng._buckets.values())
+
+
+def test_rowshard_overflow_recovery_parity():
+    """Tiny extraction caps force the per-chip raw-diff recovery; events
+    stay bit-identical and the caps grow."""
+    eng, oracle = make_engines()
+    cap, n = 1024, 500
+    h = eng.create_space(cap)
+    oh = oracle.create_space(cap)
+    h.bucket._max_chunks = 1  # any real tick overflows
+    h.bucket._step_cache.clear()
+    rng = np.random.default_rng(3)
+    x = rng.uniform(0, 600, n).astype(np.float32)
+    z = rng.uniform(0, 600, n).astype(np.float32)
+    r = np.full(n, 80, np.float32)
+    act = np.ones(n, bool)
+    for t in range(2):
+        x, z = walk(rng, x, z, n, world=600.0)
+        eng.submit(h, x, z, r, act)
+        oracle.submit(oh, x, z, r, act)
+        eng.flush(); oracle.flush()
+        e, l = eng.take_events(h)
+        ce, cl = oracle.take_events(oh)
+        np.testing.assert_array_equal(e, ce, err_msg=f"t={t}")
+        np.testing.assert_array_equal(l, cl, err_msg=f"t={t}")
+    assert h.bucket._max_chunks > 1
+
+
+def test_rowshard_subscription_masks_stream():
+    """An all-plain oversized space opts out: no events, no stream -- state
+    still evolves bit-exactly on device."""
+    eng, oracle = make_engines()
+    cap, n = 1024, 600
+    h = eng.create_space(cap)
+    oh = oracle.create_space(cap)
+    eng.set_subscribed(h, False)
+    rng = np.random.default_rng(5)
+    x = rng.uniform(0, 1200, n).astype(np.float32)
+    z = rng.uniform(0, 1200, n).astype(np.float32)
+    r = np.full(n, 70, np.float32)
+    act = np.ones(n, bool)
+    for t in range(3):
+        x, z = walk(rng, x, z, n, world=1200.0)
+        eng.submit(h, x, z, r, act)
+        oracle.submit(oh, x, z, r, act)
+        eng.flush(); oracle.flush()
+        assert eng.take_events(h)[0].size == 0
+        oracle.take_events(oh)
+    ow = oracle._buckets[("cpu", cap)]._oracles[oh.slot].prev_words
+    np.testing.assert_array_equal(h.bucket.get_prev(h.slot), ow)
+    # re-subscribe: parity resumes from the device truth
+    eng.set_subscribed(h, True)
+    x, z = walk(rng, x, z, n, world=1200.0)
+    eng.submit(h, x, z, r, act)
+    oracle.submit(oh, x, z, r, act)
+    eng.flush(); oracle.flush()
+    e, l = eng.take_events(h)
+    ce, cl = oracle.take_events(oh)
+    np.testing.assert_array_equal(e, ce)
+    np.testing.assert_array_equal(l, cl)
+
+
+def test_growth_crosses_into_rowshard():
+    """Engine-level growth across the row-shard threshold: a slot-sharded
+    mesh space grows into a row-sharded bucket with its interest state
+    carried (no spurious events)."""
+    eng, oracle = make_engines(thresh=2048)
+    cap, n = 1024, 400
+    h = eng.create_space(cap)
+    from goworld_tpu.engine.aoi_mesh import _MeshTPUBucket
+    from goworld_tpu.engine.aoi_rowshard import _RowShardTPUBucket
+
+    assert isinstance(h.bucket, _MeshTPUBucket)
+    oh = oracle.create_space(cap)
+    rng = np.random.default_rng(7)
+    x = rng.uniform(0, 900, n).astype(np.float32)
+    z = rng.uniform(0, 900, n).astype(np.float32)
+    r = np.full(n, 60, np.float32)
+    act = np.ones(n, bool)
+    eng.submit(h, x, z, r, act)
+    oracle.submit(oh, x, z, r, act)
+    eng.flush(); oracle.flush()
+    np.testing.assert_array_equal(eng.take_events(h)[0],
+                                  oracle.take_events(oh)[0])
+    h = eng.grow_space(h, 2048)
+    oh = oracle.grow_space(oh, 2048)
+    assert isinstance(h.bucket, _RowShardTPUBucket)
+    # grown space, same positions padded: the carried state emits nothing
+    n2 = 700
+    x2 = np.concatenate([x, rng.uniform(0, 900, n2 - n)]).astype(np.float32)
+    z2 = np.concatenate([z, rng.uniform(0, 900, n2 - n)]).astype(np.float32)
+    r2 = np.full(n2, 60, np.float32)
+    a2 = np.concatenate([act, np.ones(n2 - n, bool)])
+    eng.submit(h, x2, z2, r2, a2)
+    oracle.submit(oh, x2, z2, r2, a2)
+    eng.flush(); oracle.flush()
+    e, l = eng.take_events(h)
+    ce, cl = oracle.take_events(oh)
+    np.testing.assert_array_equal(e, ce, err_msg="post-growth enters")
+    np.testing.assert_array_equal(l, cl, err_msg="post-growth leaves")
+    assert len(e) > 0
+
+
+def test_runtime_space_on_rowshard():
+    """Runtime.tick end-to-end: a pre-sized space lands on the row-sharded
+    calculator; hooks, lazy derivation, and client-sync flags all behave."""
+    from goworld_tpu.engine.entity import Entity
+    from goworld_tpu.engine.runtime import Runtime
+    from goworld_tpu.engine.space import Space
+    from goworld_tpu.engine.vector import Vector3
+
+    seen = []
+
+    class Scene(Space):
+        pass
+
+    class Mob(Entity):
+        use_aoi = True
+        aoi_distance = 50.0
+
+    class Watcher(Entity):
+        use_aoi = True
+        aoi_distance = 50.0
+
+        def on_enter_aoi(self, other):
+            seen.append(other.id)
+
+    mesh = make_mesh(8)
+    rt = Runtime(aoi_backend="tpu", aoi_mesh=mesh,
+                 aoi_rowshard_min_capacity=1024)
+    for cls in (Scene, Mob, Watcher):
+        rt.entities.register(cls)
+    sp = rt.entities.create_space("Scene", kind=1)
+    sp.enable_aoi(50.0, capacity=1024)
+    from goworld_tpu.engine.aoi_rowshard import _RowShardTPUBucket
+
+    assert isinstance(sp._aoi_handle.bucket, _RowShardTPUBucket)
+    a = rt.entities.create("Mob", space=sp, pos=Vector3(0, 0, 0))
+    b = rt.entities.create("Mob", space=sp, pos=Vector3(10, 0, 10))
+    w = rt.entities.create("Watcher", space=sp, pos=Vector3(5, 0, 5))
+    rt.tick()
+    assert sorted(seen) == sorted([a.id, b.id])
+    assert set(a.neighbors()) == {b, w}  # derive_row path
+    assert set(b.observers()) == {a, w}  # derive_col path
+    b.destroy()  # clear path: synchronous severing, no re-emit
+    rt.tick()
+    assert set(a.neighbors()) == {w}
