@@ -509,17 +509,23 @@ def bench_tpu(cfg, qx, qz, xs, zs):
 
     t_device, t_device_wall, degenerate = marginal_drain(
         drain, n_chunks, chunk, ticks, min(cfg.reps, 3))
-    # wire probe: bulk D2H bandwidth right now (best of 3 on a 4 MB
-    # buffer), so the artifact itself can compute the achievable e2e from
-    # the day's weather -- stream_bytes / wire_MBps is the wire's share of
-    # each tick on this tunnel (a colocated deployment pays PCIe instead)
-    probe = jnp.zeros(1 << 20, jnp.uint32)
-    jax.block_until_ready(probe)
+    # wire probe: bulk D2H bandwidth right now (best of 3), so the artifact
+    # itself can compute the achievable e2e from the day's weather --
+    # stream_bytes / wire_MBps is the wire's share of each tick on this
+    # tunnel (a colocated deployment pays PCIe instead).  Each rep fetches
+    # a FRESH random buffer: jax caches the host copy of a fetched array
+    # (a re-fetch times the cache, ~us), and all-zero pages compress on the
+    # tunnel -- both made a first cut read 600 GB/s.
+    prng = np.random.default_rng(99)
     wire_t = []
-    for _ in range(3):
+    for _i in range(3):
+        probe = jnp.asarray(prng.integers(0, 1 << 32, 1 << 20,
+                                          dtype=np.uint32))
+        jax.block_until_ready(probe)
         t0 = time.perf_counter()
         np.asarray(probe)
         wire_t.append(time.perf_counter() - t0)
+        del probe
     wire_mbps = (4 << 20) / min(wire_t) / 1e6
     d2h_bytes = r_ship * row_bytes + meta_cols * 4
     h2d_bytes = 2 * s * cap  # int8 position deltas

@@ -115,7 +115,7 @@ def _fused_bucket_step(prev_all, *args):
         import jax
         import jax.numpy as jnp
 
-        from ..ops.aoi_pallas import aoi_step_pallas
+        from ..ops.aoi_dense import aoi_step_chg
 
         @functools.partial(
             jax.jit,
@@ -125,7 +125,9 @@ def _fused_bucket_step(prev_all, *args):
                  csel_buf, slot_idx, x, z, r, act, sub, max_chunks, kcap,
                  max_gaps, max_exc):
             prev_rows = prev_all[slot_idx]
-            new, chg = aoi_step_pallas(x, z, r, act, prev_rows, emit="chg")
+            # platform routing (pallas on TPU, fused dense elsewhere) lives
+            # in ONE place: ops/aoi_dense.aoi_step_chg
+            new, chg = aoi_step_chg(x, z, r, act, prev_rows)
             prev_all = prev_all.at[slot_idx].set(new)
             # subscription mask: slots with no event consumers (all-plain
             # spaces -- their interest state lives in the packed words,

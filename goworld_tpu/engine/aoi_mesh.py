@@ -378,16 +378,18 @@ class _MeshTPUBucket(_Bucket):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as PS
 
-        from ..ops.aoi_pallas import aoi_step_pallas
+        from ..ops.aoi_dense import aoi_step_chg
 
-        interpret = self.mesh.platform != "tpu"
+        platform = self.mesh.platform
         mc, kcap = self._max_chunks, self._kcap
         mg, mx = self._max_gaps, self._max_exc
 
         def _local(prev, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
                    x, z, r, act, sub):
-            new, chg = aoi_step_pallas(x, z, r, act, prev, emit="chg",
-                                       interpret=interpret)
+            # platform routing (pallas on TPU, fused dense elsewhere --
+            # interpret-mode Pallas walks its grid step-by-step in Python,
+            # ~49 s/flush at cap 16384) lives in ops/aoi_dense.aoi_step_chg
+            new, chg = aoi_step_chg(x, z, r, act, prev, platform=platform)
             # subscription mask: all-plain spaces contribute nothing to the
             # event stream (see engine/aoi._fused_bucket_step); ``new`` is
             # unmasked -- prev stays authoritative

@@ -145,9 +145,9 @@ class _RowShardTPUBucket(_Bucket):
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as PS
 
-        from ..ops.aoi_pallas import aoi_step_pallas
+        from ..ops.aoi_dense import aoi_step_chg
 
-        interpret = self.mesh.platform != "tpu"
+        platform = self.mesh.platform
         mc, kcap = self._max_chunks, self._kcap
         mg, mx = self._max_gaps, self._max_exc
         cl = self.c_local
@@ -157,10 +157,11 @@ class _RowShardTPUBucket(_Bucket):
                    xs, zs, rs, acts, x_all, z_all, act_all, sub):
             lo = jax.lax.axis_index(axis) * cl
             rid = (lo + jnp.arange(cl, dtype=jnp.int32))[None]
-            new, chg = aoi_step_pallas(
+            # platform routing lives in ops/aoi_dense.aoi_step_chg
+            new, chg = aoi_step_chg(
                 xs[None], zs[None], rs[None], acts[None], prev_blk[None],
-                emit="chg", interpret=interpret,
-                cols=(x_all[None], z_all[None], act_all[None]), row_ids=rid)
+                cols=(x_all[None], z_all[None], act_all[None]),
+                row_ids=rid, platform=platform)
             new, chg = new[0], chg[0]
             # subscription mask (see engine/aoi._fused_bucket_step): ``new``
             # stays unmasked -- prev is authoritative

@@ -425,3 +425,103 @@ def test_mesh_subscription_masks_stream_and_peek_refreshes():
         np.testing.assert_array_equal(
             hs[s].bucket.peek_words(hs[s].slot),
             ohs[s].bucket.peek_words(ohs[s].slot), err_msg=f"peek s={s}")
+
+
+def test_mesh_cap16384_production_shape():
+    """Round-4 verdict item 6: the mesh engine at the chipshare/million
+    per-chip PRODUCTION shape (8 slots x cap 16384, one per chip),
+    PIPELINED: parity vs the oracle, a clear storm (silent), a growth
+    (8192 -> 16384, state carried through the packed column remap), and
+    full_roundtrips pinned at zero through single-slot state carry.
+    Budgeted: one shape, few ticks, extraction caps pinned up front and
+    the growth runs FIRST so the big fused program compiles exactly once
+    at s_max=8 (the dense non-TPU step makes a 16384 mesh flush ~4 s;
+    interpret-mode Pallas took ~49 s)."""
+    mesh = make_mesh(8)
+    eng = AOIEngine(default_backend="tpu", mesh=mesh, pipeline=True)
+    oracle = AOIEngine(default_backend="cpu")
+    cap = 16384
+    rng = np.random.default_rng(6)
+
+    # -- growth INTO the production shape first (8192 -> 16384): the grown
+    # bucket IS the production bucket, so its big program compiles once
+    hb = eng.create_space(8192)
+    ob = oracle.create_space(8192)
+    nb = 800
+    xb = rng.uniform(0, 5000, nb).astype(np.float32)
+    rb = np.full(nb, 80, np.float32)
+    ab = np.ones(nb, bool)
+    hb.bucket._caps.refit_at = 10**9  # no decay-shrink recompiles mid-test
+    eng.submit(hb, xb, xb, rb, ab)
+    oracle.submit(ob, xb, xb, rb, ab)
+    eng.flush(); oracle.flush()
+    eng.flush()  # trailing: deliver the pipelined enter batch
+    hb.bucket.drain()
+    np.testing.assert_array_equal(eng.take_events(hb)[0],
+                                  oracle.take_events(ob)[0])
+    hb = eng.grow_space(hb, cap)
+    ob = oracle.grow_space(ob, cap)
+    big = hb.bucket
+    # pin generous extraction caps BEFORE the first 16384 flush: a cap
+    # growth mid-test would recompile the fused program (~20 s each here)
+    big._max_chunks = 16384
+    big._kcap = 16
+    big._caps.refit_at = 10**9
+    eng.submit(hb, xb, xb, rb, ab)
+    oracle.submit(ob, xb, xb, rb, ab)
+    eng.flush(); oracle.flush()
+    big.drain()
+    e, l = eng.take_events(hb)
+    ce, cl = oracle.take_events(ob)
+    np.testing.assert_array_equal(e, ce, err_msg="post-growth enters")
+    np.testing.assert_array_equal(l, cl, err_msg="post-growth leaves")
+    assert e.size == 0 and l.size == 0  # carried state: growth is silent
+
+    # -- parity + storm at the production shape (second slot, same bucket)
+    n = 1500
+    h = eng.create_space(cap)
+    oh = oracle.create_space(cap)
+    assert h.bucket is big
+    x = rng.uniform(0, 8000, n).astype(np.float32)
+    z = rng.uniform(0, 8000, n).astype(np.float32)
+    r = rng.uniform(40, 100, n).astype(np.float32)
+    act = np.ones(n, bool)
+
+    def tick(xa, aa):
+        eng.submit(h, xa, z, r, aa)
+        oracle.submit(oh, xa, z, r, aa)
+        eng.flush(); oracle.flush()
+        return eng.take_events(h), oracle.take_events(oh)
+
+    (me, ml), o_first = tick(x, act)  # pipelined: dispatch only
+    assert me.size == 0 and ml.size == 0
+    x2 = np.clip(x + rng.uniform(-25, 25, n), 0, 8000).astype(np.float32)
+    (me, ml), o_second = tick(x2, act)  # delivers tick 0
+    np.testing.assert_array_equal(me, o_first[0])
+    np.testing.assert_array_equal(ml, o_first[1])
+
+    # clear storm while the pipeline is live
+    gone = rng.choice(n, 200, replace=False)
+    act2 = act.copy()
+    act2[gone] = False
+    for s_ in gone:
+        eng.clear_entity(h, int(s_))
+        oracle.clear_entity(oh, int(s_))
+    (me, ml), o_storm = tick(x2, act2)  # delivers tick 1
+    np.testing.assert_array_equal(me, o_second[0])
+    np.testing.assert_array_equal(ml, o_second[1])
+    big.drain()  # deliver the storm tick
+    me, ml = eng.take_events(h)
+    np.testing.assert_array_equal(me, o_storm[0])
+    np.testing.assert_array_equal(ml, o_storm[1])
+    assert len(ml) == 0  # the storm is silent
+
+    # single-slot state carry must not round-trip the full [S, C, W] state
+    words = big.get_prev(h.slot)
+    big.set_prev(h.slot, words)
+    eng.submit(h, x2, z, r, act2)
+    eng.flush()
+    big.drain()
+    eng.take_events(h)
+    assert big.full_roundtrips == 0, (
+        "full-array host round-trip on the steady-state path")

@@ -76,3 +76,45 @@ def test_pallas_block_rows_invariance():
     b = aoi_step_pallas(x, z, r, act, prev, block_rows=64)
     for u, v in zip(a, b):
         np.testing.assert_array_equal(np.asarray(u), np.asarray(v))
+
+
+def test_rect_kernel_matches_dense_rect_all_branches():
+    """The Pallas kernel's RECTANGULAR mode (cols=/row_ids= -- the
+    row-sharded oversized-space path) vs the dense rect formulation,
+    bit-exact, across all three pack branches (MXU C=512, slice-pack
+    C=4096, plane-wise C=65536) and at a NON-ZERO row-block offset so
+    cross-block self-exclusion via global row ids is exercised.  The
+    engines route through the dense path off-TPU, so this interpret-mode
+    run is what keeps the kernel's rect path honest in CI."""
+    import jax.numpy as jnp
+
+    from goworld_tpu.ops.aoi_dense import interest_words_dense_rect
+    from goworld_tpu.ops.aoi_pallas import aoi_step_pallas
+    from goworld_tpu.ops.aoi_predicate import words_per_row
+
+    rng = np.random.default_rng(17)
+    for c, lo, rows in ((512, 128, 128), (4096, 256, 128), (65536, 512, 128)):
+        w = words_per_row(c)
+        x = rng.uniform(0, 900, c).astype(np.float32)
+        z = rng.uniform(0, 900, c).astype(np.float32)
+        r = rng.uniform(20, 80, c).astype(np.float32)
+        act = rng.random(c) < 0.9
+        rid = np.arange(lo, lo + rows, dtype=np.int32)
+        prev = rng.integers(0, 1 << 32, (rows, w), dtype=np.uint32)
+        new_p, chg_p = aoi_step_pallas(
+            x[None, lo:lo + rows], z[None, lo:lo + rows],
+            r[None, lo:lo + rows], act[None, lo:lo + rows],
+            jnp.asarray(prev[None]), emit="chg", interpret=True,
+            cols=(jnp.asarray(x[None]), jnp.asarray(z[None]),
+                  jnp.asarray(act[None])),
+            row_ids=jnp.asarray(rid[None]))
+        new_d = interest_words_dense_rect(
+            jnp.asarray(x[lo:lo + rows]), jnp.asarray(z[lo:lo + rows]),
+            jnp.asarray(r[lo:lo + rows]), jnp.asarray(act[lo:lo + rows]),
+            jnp.asarray(x), jnp.asarray(z), jnp.asarray(act),
+            jnp.asarray(rid))
+        np.testing.assert_array_equal(np.asarray(new_p[0]),
+                                      np.asarray(new_d), err_msg=f"C={c}")
+        np.testing.assert_array_equal(np.asarray(chg_p[0]),
+                                      np.asarray(new_d) ^ prev,
+                                      err_msg=f"C={c} chg")

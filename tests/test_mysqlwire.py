@@ -106,3 +106,86 @@ def test_storage_service_against_wire_mysql(server):
     assert svc.wait_idle(5.0)
     svc.close()
     assert done == ["saved", {"hp": 10, "inv": [1, "x"]}]
+
+
+def _libmariadb():
+    import ctypes
+
+    try:
+        lib = ctypes.CDLL("libmariadb.so.3")
+    except OSError:
+        return None
+    lib.mysql_init.restype = ctypes.c_void_p
+    lib.mysql_real_connect.restype = ctypes.c_void_p
+    lib.mysql_real_connect.argtypes = (
+        [ctypes.c_void_p] + [ctypes.c_char_p] * 4
+        + [ctypes.c_uint, ctypes.c_char_p, ctypes.c_ulong])
+    lib.mysql_error.restype = ctypes.c_char_p
+    lib.mysql_error.argtypes = [ctypes.c_void_p]
+    lib.mysql_query.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mysql_store_result.restype = ctypes.c_void_p
+    lib.mysql_store_result.argtypes = [ctypes.c_void_p]
+    lib.mysql_num_fields.argtypes = [ctypes.c_void_p]
+    # raw void* cells, NOT c_char_p: auto-conversion truncates at the
+    # first NUL byte and turns an empty/binary value falsy
+    lib.mysql_fetch_row.restype = ctypes.POINTER(ctypes.c_void_p)
+    lib.mysql_fetch_row.argtypes = [ctypes.c_void_p]
+    lib.mysql_fetch_lengths.restype = ctypes.POINTER(ctypes.c_ulong)
+    lib.mysql_fetch_lengths.argtypes = [ctypes.c_void_p]
+    lib.mysql_free_result.argtypes = [ctypes.c_void_p]
+    lib.mysql_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+@pytest.mark.skipif(_libmariadb() is None,
+                    reason="libmariadb.so.3 not available")
+def test_independent_client_libmariadb(server):
+    """The hermetic wire server talks to an INDEPENDENT canonical client:
+    MariaDB's own libmariadb (via ctypes).  The in-repo driver and server
+    share one author's protocol assumptions; this run breaks half that
+    circularity without a real mysqld -- if MariaDB's client accepts the
+    handshake, auth, result sets, and error packets, the server speaks the
+    real protocol, and the driver is validated transitively (driver and
+    libmariadb both agree with the same server bytes).  Reference analog:
+    live-mysqld CI services (/root/reference/.travis.yml:27-35)."""
+    import ctypes
+
+    lib = _libmariadb()
+    conn = lib.mysql_init(None)
+    assert lib.mysql_real_connect(conn, b"127.0.0.1", b"root", b"",
+                                  b"main", server.port, None, 0), \
+        lib.mysql_error(conn).decode()
+    try:
+        for q in (b"CREATE TABLE IF NOT EXISTS it "
+                  b"(k VARCHAR(32) PRIMARY KEY, v BLOB, n TEXT)",
+                  b"REPLACE INTO it (k, v, n) VALUES "
+                  b"('bin', x'00ff41', NULL)"):
+            assert lib.mysql_query(conn, q) == 0, \
+                lib.mysql_error(conn).decode()
+        assert lib.mysql_query(
+            conn, b"SELECT k, v, n FROM it WHERE k = 'bin'") == 0
+        res = lib.mysql_store_result(conn)
+        assert res, lib.mysql_error(conn).decode()
+        nf = lib.mysql_num_fields(res)
+        assert nf == 3
+        row = lib.mysql_fetch_row(res)
+        lens = lib.mysql_fetch_lengths(res)
+        # binary-safe reads: length array + raw pointers (NULL -> None)
+        vals = [ctypes.string_at(row[i], lens[i]) if row[i] else None
+                for i in range(nf)]
+        assert vals == [b"bin", b"\x00\xff\x41", None]
+        assert not lib.mysql_fetch_row(res)
+        lib.mysql_free_result(res)
+        # error packets surface through the independent client too
+        assert lib.mysql_query(conn, b"SELECT broken syntax from from") != 0
+        err = lib.mysql_error(conn).decode()
+        assert err, "error packet did not surface"
+        # and the connection survives the failed query
+        assert lib.mysql_query(conn, b"SELECT COUNT(*) FROM it") == 0
+        res = lib.mysql_store_result(conn)
+        row = lib.mysql_fetch_row(res)
+        lens = lib.mysql_fetch_lengths(res)
+        assert ctypes.string_at(row[0], lens[0]) == b"1"
+        lib.mysql_free_result(res)
+    finally:
+        lib.mysql_close(conn)
