@@ -820,7 +820,9 @@ class _TPUBucket(_Bucket):
         (self.prev, new, chg, g_vals, g_nv, g_lane, g_csel,
          rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg, exc_new,
          scalars) = out
-        scalars.copy_to_host_async()
+        all_unsub = not sub.any()
+        if not all_unsub:
+            scalars.copy_to_host_async()
         rec = {
             "slots": slots, "s_n": s_n, "key": key, "mc": mc,
             "kcap": self._kcap,
@@ -829,9 +831,15 @@ class _TPUBucket(_Bucket):
             "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
                         exc_new),
             "scalars": scalars,
+            # every staged slot unsubscribed: the stream is empty BY
+            # CONSTRUCTION (chg masked on device), so the harvest needs no
+            # fetch at all -- not even the scalars (one tiny synchronous
+            # wait still costs a tunnel RTT when the host tick is shorter
+            # than the wire latency)
+            "all_unsub": all_unsub,
             "prefetch": None,
         }
-        if self.pipeline and sub.any():
+        if self.pipeline and not all_unsub:
             # optimistic prefetch at the recent ticks' observed stream sizes:
             # the D2H rides the wire while the host runs the next tick's
             # logic; the harvest refetches exact slices on a misfit (rare --
@@ -878,8 +886,11 @@ class _TPUBucket(_Bucket):
         # under the pipeline it was issued async at dispatch and is local by
         # now
         t_f0 = time.perf_counter()
-        nd, mcc, base_row, n_esc, exc_n = (int(v) for v in
-                                           np.asarray(rec["scalars"]))
+        if rec.get("all_unsub"):
+            nd = mcc = base_row = n_esc = exc_n = 0
+        else:
+            nd, mcc, base_row, n_esc, exc_n = (int(v) for v in
+                                               np.asarray(rec["scalars"]))
         shrink = self._caps.observe(nd, mcc, self._max_chunks, self._kcap)
         if shrink is not None:
             self._max_chunks, self._kcap = shrink

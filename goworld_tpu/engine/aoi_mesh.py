@@ -452,7 +452,8 @@ class _MeshTPUBucket(_Bucket):
                 self._harvest()
             return
         t0 = time.perf_counter()
-        if self.pipeline and self._inflight is not None:
+        if self.pipeline and self._inflight is not None \
+                and not self._inflight.get("all_unsub"):
             # peek the inflight tick's scalars (async-fetched at its
             # dispatch, host-local by now): a ROW overflow recovery reads
             # the NEW interest words, i.e. self.prev -- which maintenance
@@ -460,6 +461,7 @@ class _MeshTPUBucket(_Bucket):
             # cleared entity to leaves) and the next dispatch donates.
             # Harvest BEFORE both in that rare case; the pipeline stalls
             # one tick instead of misclassifying or reading freed memory.
+            # (an all-unsub tick cannot overflow: its stream is empty)
             nd_mcc = np.asarray(self._inflight["scalars"])[:, :2]
             mc_i, kcap_i = self._inflight["caps"][:2]
             if (nd_mcc[:, 0] > mc_i).any() or (nd_mcc[:, 1] > kcap_i).any():
@@ -500,7 +502,15 @@ class _MeshTPUBucket(_Bucket):
         (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos,
          woff, esc_rows, exc_gidx, exc_chg, exc_new, scalars) = out
         self.prev = new  # the step's new words ARE next tick's prev
-        scalars.copy_to_host_async()
+        # every staged slot unsubscribed (and unstaged slots re-step
+        # identical inputs -> zero diff): the stream is empty by
+        # construction, so the harvest needs NO fetch -- not even scalars
+        # (one tiny synchronous wait costs a tunnel RTT when the host tick
+        # is shorter than the wire latency)
+        all_unsub = bool(self._unsub) and all(s in self._unsub
+                                              for s in staged_slots)
+        if not all_unsub:
+            scalars.copy_to_host_async()
         rec = {
             "slots": staged_slots,
             "epochs": {s: self._slot_epoch.get(s, 0)
@@ -511,11 +521,10 @@ class _MeshTPUBucket(_Bucket):
             "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
                         exc_new),
             "scalars": scalars,
+            "all_unsub": all_unsub,
             "prefetch": None,
         }
-        if self.pipeline and (not self._unsub
-                              or any(s not in self._unsub
-                                     for s in staged_slots)):
+        if self.pipeline and not all_unsub:
             # optimistic per-chip prefetch at recently observed stream
             # sizes; the harvest refetches exact slices on a misfit (an
             # all-unsubscribed tick's stream is empty by construction --
@@ -562,7 +571,10 @@ class _MeshTPUBucket(_Bucket):
         (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
          exc_new) = rec["streams"]
         t0 = time.perf_counter()
-        scal_h = np.asarray(rec["scalars"])  # [n_dev, 5]
+        if rec.get("all_unsub"):
+            scal_h = np.zeros((self.n_dev, 5), np.int64)
+        else:
+            scal_h = np.asarray(rec["scalars"])  # [n_dev, 5]
         self.perf["fetch_s"] += time.perf_counter() - t0
         pf = rec["prefetch"]
         all_c, all_e, all_g = [], [], []

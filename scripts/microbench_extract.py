@@ -6,6 +6,12 @@ one tunnel dispatch+sync of fixed cost (~30-120 ms) amortized over ITERS
 -- treat per-iter numbers as upper bounds, and for decisions re-measure
 the finalists as MARGINALS over two chain lengths (the difference cancels
 every fixed cost; bench.py's sentinel and drains now do exactly this).
+
+CAVEAT 2 (round-5 finding, CHANGES_r05.md item 7): on this harness
+``block_until_ready`` can return EAGERLY -- a chained scalar reduction
+over 2.1 GB timed 0.0 ms "marginal" with it.  Every measured run must end
+with a REAL host fetch (``np.asarray`` of an output); the fetch's fixed
+RTT cancels in the marginal difference.
 Conclusions that survived marginal re-measurement: the kernel dominates
 device time at both shapes; extraction+encode is ~1 ms at 8x8192 and
 ~15 ms at million scale; top_k vs scatter vs hierarchical compaction all
