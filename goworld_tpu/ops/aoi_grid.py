@@ -56,9 +56,10 @@ def _mask_block(x_row, z_row, r_row, xc, zc, *, ti, col_off, bi):
     return m & (row_ids != col_ids)
 
 
-def _culled_kernel(need, x_row, z_row, r_row, x_col, z_col, out, *, ti, w,
-                   wb):
-    """Planewise slice-pack with whole-step SMEM culling.
+def _accumulate_culled_plane(need, x_row, z_row, r_row, x_col, z_col, out,
+                             *, ti, w, wb):
+    """One grid step of the planewise slice-pack with whole-step SMEM
+    culling -- the shared body of both culled kernels.
 
     Grid (S, C//ti, w//wb, 32): step (si, bi, wo, k) computes bit plane k
     over words [wo*wb, (wo+1)*wb); the out block accumulates across the
@@ -92,6 +93,100 @@ def _culled_kernel(need, x_row, z_row, r_row, x_col, z_col, out, *, ti, w,
         out[0] = out[0] | partu
 
 
+def _culled_kernel(need, x_row, z_row, r_row, x_col, z_col, out, *, ti, w,
+                   wb):
+    _accumulate_culled_plane(need, x_row, z_row, r_row, x_col, z_col, out,
+                             ti=ti, w=w, wb=wb)
+
+
+def _culled_step_kernel(need, x_row, z_row, r_row, x_col, z_col, prev,
+                        new_out, chg_out, *, ti, w, wb):
+    """The ``_culled_kernel`` structure fused with the prev-words diff.
+
+    ``new`` accumulates across the innermost plane dim exactly as in
+    ``_culled_kernel``; ``chg = new ^ prev`` is rewritten from the running
+    accumulator every step (unconditionally -- a VMEM write is cheap and
+    both out blocks land in HBM once per revisit window), so the last
+    plane's value is the true diff even when that plane's step is culled.
+    """
+    _accumulate_culled_plane(need, x_row, z_row, r_row, x_col, z_col,
+                             new_out, ti=ti, w=w, wb=wb)
+    chg_out[0] = new_out[0] ^ prev[0]
+
+
+def _legal_blocks(c, w, block_rows, col_words, interpret):
+    ti = min(block_rows, c)
+    if ti != c:
+        ti = (ti // 128) * 128
+        if ti == 0 or c % ti != 0:
+            ti = c
+    wb = col_words or min(w, 512)
+    while w % wb:
+        wb //= 2
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if not interpret and wb < 128:
+        # Mosaic lane rule: the column/out blocks ride the lane dim, so the
+        # word window must be >= 128 -- i.e. this kernel needs W >= 128
+        # (C >= 4096).  Below that the dense kernel is the right tool
+        # anyway (the whole space fits a handful of blocks).
+        raise ValueError(
+            f"culled kernel needs col_words >= 128 on TPU (got wb={wb} "
+            f"at C={c}); use ops.aoi_pallas.aoi_step_pallas below C=4096")
+    return ti, wb, interpret
+
+
+def _cull_table(x, radius, active, x_eff, r_eff, *, s, c, ti, wb):
+    """need[si, bi, wo, k] (int32) + culled fraction (f32 scalar).
+
+    Row block bi reaches x in [min(x-r), max(x+r)]; column group (wo, k)
+    covers entities [k*w + wo*wb, k*w + (wo+1)*wb) and spans [min x, max x].
+    Bounds are widened by an absolute f32-safety margin so the cull can
+    only ever ADMIT extra blocks (every admitted pair is re-checked by the
+    exact predicate); empty blocks drop via the +-inf folds.
+    """
+    w = words_per_row(c)
+    n_bi = c // ti
+    n_wo = w // wb
+    # conservative f32 margin: bounds may round, the predicate is exact, so
+    # the window only needs to be a hair wider than any rounding error
+    margin = jnp.float32(1e-3) + jnp.float32(1e-5) * (
+        jnp.max(jnp.where(active, jnp.abs(x), 0.0)) + jnp.max(radius))
+    xr_blocks = x_eff.reshape(s, n_bi, ti)
+    rr_blocks = r_eff.reshape(s, n_bi, ti)
+    fin = jnp.isfinite(xr_blocks)
+    row_lo = jnp.min(jnp.where(fin, xr_blocks - rr_blocks, jnp.float32(_INF)),
+                     axis=2) - margin
+    row_hi = jnp.max(jnp.where(fin, xr_blocks + rr_blocks,
+                               jnp.float32(-_INF)), axis=2) + margin
+    # reshape to [s, 32, n_wo, wb] puts k before wo
+    xc = x_eff.reshape(s, WORD_BITS, n_wo, wb)
+    finc = jnp.isfinite(xc)
+    col_lo = jnp.min(jnp.where(finc, xc, jnp.float32(_INF)), axis=3)
+    col_hi = jnp.max(jnp.where(finc, xc, jnp.float32(-_INF)), axis=3)
+    need = ((col_lo[:, None, :, :] <= row_hi[:, :, None, None])
+            & (col_hi[:, None, :, :] >= row_lo[:, :, None, None]))
+    need = jnp.swapaxes(need, 2, 3).astype(jnp.int32)  # -> [s, bi, wo, k]
+    culled_frac = 1.0 - jnp.mean(need.astype(jnp.float32))
+    return need, culled_frac
+
+
+def _culled_specs(c, w, ti, wb, n_wo):
+    row_spec = pl.BlockSpec(
+        (1, 1, ti), lambda si, bi, wo, k: (si, 0, bi))
+    col_spec = pl.BlockSpec(
+        (1, 1, wb), lambda si, bi, wo, k: (si, 0, k * (w // wb) + wo))
+    out_spec = pl.BlockSpec(
+        (1, ti, wb), lambda si, bi, wo, k: (si, bi, wo))
+    # SMEM blocks must keep the LAST TWO dims whole (Mosaic: divisible by
+    # (8, 128) or equal to the array dims), so the block spans all of
+    # (n_wo, 32) and the kernel indexes (wo, k) dynamically
+    need_spec = pl.BlockSpec(
+        (1, 1, n_wo, WORD_BITS), lambda si, bi, wo, k: (si, bi, 0, 0),
+        memory_space=pltpu.SMEM)
+    return row_spec, col_spec, out_spec, need_spec
+
+
 @functools.partial(jax.jit,
                    static_argnames=("block_rows", "col_words", "interpret"))
 def aoi_words_culled(x, z, radius, active, *, block_rows=128, col_words=0,
@@ -110,73 +205,22 @@ def aoi_words_culled(x, z, radius, active, *, block_rows=128, col_words=0,
     """
     s, c = x.shape
     w = words_per_row(c)
-    ti = min(block_rows, c)
-    if ti != c:
-        ti = (ti // 128) * 128
-        if ti == 0 or c % ti != 0:
-            ti = c
-    wb = col_words or min(w, 512)
-    while w % wb:
-        wb //= 2
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    if not interpret and wb < 128:
-        # Mosaic lane rule: the column/out blocks ride the lane dim, so the
-        # word window must be >= 128 -- i.e. this kernel needs W >= 128
-        # (C >= 4096).  Below that the dense kernel is the right tool
-        # anyway (the whole space fits a handful of blocks).
-        raise ValueError(
-            f"aoi_words_culled needs col_words >= 128 on TPU (got wb={wb} "
-            f"at C={c}); use ops.aoi_pallas.aoi_step_pallas below C=4096")
+    ti, wb, interpret = _legal_blocks(c, w, block_rows, col_words, interpret)
 
     x_eff = jnp.where(active, x, jnp.float32(_INF))
     z_eff = jnp.where(active, z, jnp.float32(_INF))
     r_eff = jnp.where(active, radius, jnp.float32(-1.0))
-
-    # ---- cull table (outside pallas; tiny) -------------------------------
-    n_bi = c // ti
-    n_wo = w // wb
-    # conservative f32 margin: bounds may round, the predicate is exact, so
-    # the window only needs to be a hair wider than any rounding error
-    margin = jnp.float32(1e-3) + jnp.float32(1e-5) * (
-        jnp.max(jnp.where(active, jnp.abs(x), 0.0)) + jnp.max(radius))
-    xr_blocks = x_eff.reshape(s, n_bi, ti)
-    rr_blocks = r_eff.reshape(s, n_bi, ti)
-    fin = jnp.isfinite(xr_blocks)
-    row_lo = jnp.min(jnp.where(fin, xr_blocks - rr_blocks, jnp.float32(_INF)),
-                     axis=2) - margin
-    row_hi = jnp.max(jnp.where(fin, xr_blocks + rr_blocks,
-                               jnp.float32(-_INF)), axis=2) + margin
-    # column group (wo, k) covers entities [k*w + wo*wb, k*w + (wo+1)*wb):
-    # reshape to [s, 32, n_wo, wb] puts k before wo
-    xc = x_eff.reshape(s, WORD_BITS, n_wo, wb)
-    finc = jnp.isfinite(xc)
-    col_lo = jnp.min(jnp.where(finc, xc, jnp.float32(_INF)), axis=3)
-    col_hi = jnp.max(jnp.where(finc, xc, jnp.float32(-_INF)), axis=3)
-    # need[si, bi, wo, k] = row/column x-reach overlap (empty blocks drop)
-    need = ((col_lo[:, None, :, :] <= row_hi[:, :, None, None])
-            & (col_hi[:, None, :, :] >= row_lo[:, :, None, None]))
-    need = jnp.swapaxes(need, 2, 3).astype(jnp.int32)  # -> [s, bi, wo, k]
-    culled_frac = 1.0 - jnp.mean(need.astype(jnp.float32))
+    need, culled_frac = _cull_table(x, radius, active, x_eff, r_eff,
+                                    s=s, c=c, ti=ti, wb=wb)
 
     x3 = x_eff.reshape(s, 1, c)
     z3 = z_eff.reshape(s, 1, c)
     r3 = r_eff.reshape(s, 1, c)
-    row_spec = pl.BlockSpec(
-        (1, 1, ti), lambda si, bi, wo, k: (si, 0, bi))
-    col_spec = pl.BlockSpec(
-        (1, 1, wb), lambda si, bi, wo, k: (si, 0, k * (w // wb) + wo))
-    out_spec = pl.BlockSpec(
-        (1, ti, wb), lambda si, bi, wo, k: (si, bi, wo))
-    # SMEM blocks must keep the LAST TWO dims whole (Mosaic: divisible by
-    # (8, 128) or equal to the array dims), so the block spans all of
-    # (n_wo, 32) and the kernel indexes (wo, k) dynamically
-    need_spec = pl.BlockSpec(
-        (1, 1, n_wo, WORD_BITS), lambda si, bi, wo, k: (si, bi, 0, 0),
-        memory_space=pltpu.SMEM)
+    row_spec, col_spec, out_spec, need_spec = _culled_specs(
+        c, w, ti, wb, w // wb)
     words = pl.pallas_call(
         functools.partial(_culled_kernel, ti=ti, w=w, wb=wb),
-        grid=(s, n_bi, n_wo, WORD_BITS),
+        grid=(s, c // ti, w // wb, WORD_BITS),
         in_specs=[need_spec, row_spec, row_spec, row_spec, col_spec,
                   col_spec],
         out_specs=out_spec,
@@ -184,6 +228,54 @@ def aoi_words_culled(x, z, radius, active, *, block_rows=128, col_words=0,
         interpret=interpret,
     )(need, x3, z3, r3, x3, z3)
     return words, culled_frac
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_rows", "col_words", "interpret"))
+def aoi_step_culled(x, z, radius, active, prev_words, *, block_rows=512,
+                    col_words=0, interpret=None):
+    """One culled tick with the diff fused: ``(new, chg, culled_frac)``.
+
+    ``prev_words`` must be packed in the SAME index order as the inputs --
+    i.e. the caller keeps one x-sorted order FIXED across ticks and carries
+    the previous tick's words in it (re-sorting periodically by recomputing
+    the old words under the new order; see bench.py's fixed-order grid
+    pipeline).  Bit-exact with ``aoi_step_pallas(..., emit="chg")`` on
+    identical inputs; the cull only skips pair blocks whose widened x-reach
+    windows are disjoint, and the ``new`` accumulator plus unconditional
+    ``chg`` rewrite keep skipped blocks sound (zero bits / pure prev).
+
+    Default ``block_rows=512``: the 4-dim grid pays a fixed per-step cost,
+    and at (wo, k) granularity the step count is 8x the dense kernel's --
+    512-row blocks cut it 4x for a modest cull-width loss (measured on
+    v5e: see CHANGES_r05.md, fixed-order culled kernel).
+    """
+    s, c = x.shape
+    w = words_per_row(c)
+    ti, wb, interpret = _legal_blocks(c, w, block_rows, col_words, interpret)
+
+    x_eff = jnp.where(active, x, jnp.float32(_INF))
+    z_eff = jnp.where(active, z, jnp.float32(_INF))
+    r_eff = jnp.where(active, radius, jnp.float32(-1.0))
+    need, culled_frac = _cull_table(x, radius, active, x_eff, r_eff,
+                                    s=s, c=c, ti=ti, wb=wb)
+
+    x3 = x_eff.reshape(s, 1, c)
+    z3 = z_eff.reshape(s, 1, c)
+    r3 = r_eff.reshape(s, 1, c)
+    row_spec, col_spec, out_spec, need_spec = _culled_specs(
+        c, w, ti, wb, w // wb)
+    out_shape = jax.ShapeDtypeStruct((s, c, w), jnp.uint32)
+    new, chg = pl.pallas_call(
+        functools.partial(_culled_step_kernel, ti=ti, w=w, wb=wb),
+        grid=(s, c // ti, w // wb, WORD_BITS),
+        in_specs=[need_spec, row_spec, row_spec, row_spec, col_spec,
+                  col_spec, out_spec],
+        out_specs=(out_spec, out_spec),
+        out_shape=(out_shape, out_shape),
+        interpret=interpret,
+    )(need, x3, z3, r3, x3, z3, prev_words)
+    return new, chg, culled_frac
 
 
 def sort_spaces(x, z, radius, active):

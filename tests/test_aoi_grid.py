@@ -13,7 +13,11 @@ jnp = pytest.importorskip("jax.numpy")
 import jax
 
 from goworld_tpu.ops import aoi_predicate as P
-from goworld_tpu.ops.aoi_grid import aoi_words_culled, sort_spaces
+from goworld_tpu.ops.aoi_grid import (
+    aoi_step_culled,
+    aoi_words_culled,
+    sort_spaces,
+)
 from goworld_tpu.ops.aoi_oracle import CPUAOIOracle
 from goworld_tpu.ops.aoi_pallas import aoi_step_pallas
 
@@ -88,6 +92,94 @@ def test_culled_matches_oracle_through_permutation():
     oracle.step(x[0], z[0], r[0], act[0])
     np.testing.assert_array_equal(
         m_orig, P.unpack_rows(oracle.prev_words, c))
+
+
+def test_fused_step_bitexact_vs_dense():
+    """aoi_step_culled (prev-diff fused into the culled kernel) returns the
+    same (new, chg) as the dense kernel for every adversarial layout and
+    random prev words, across block shapes."""
+    rng = np.random.default_rng(5)
+    s, c = 2, BIG_C
+    w = P.words_per_row(c)
+    for name, x, z, r, act in layouts(rng, s, c):
+        xs, zs, rs, acts, _perm = sort_spaces(
+            jnp.asarray(x), jnp.asarray(z), jnp.asarray(r), jnp.asarray(act))
+        prev = jnp.asarray(rng.integers(
+            0, 2**32, (s, c, w), dtype=np.int64).astype(np.uint32))
+        dense_new, dense_chg = aoi_step_pallas(xs, zs, rs, acts, prev,
+                                               emit="chg")
+        for br in (128, 2 * CW):
+            new, chg, frac = aoi_step_culled(
+                xs, zs, rs, acts, prev, block_rows=br, col_words=CW)
+            np.testing.assert_array_equal(
+                np.asarray(new), np.asarray(dense_new),
+                err_msg=f"{name} br={br}")
+            np.testing.assert_array_equal(
+                np.asarray(chg), np.asarray(dense_chg),
+                err_msg=f"{name} br={br}")
+            assert 0.0 <= float(frac) <= 1.0
+
+
+def test_fixed_order_pipeline_matches_oracle():
+    """The fixed-order pipeline bench.py's grid configs run: establish an
+    x-sorted permutation, carry prev words in perm space across ticks (ONE
+    culled pass each), re-sort every K ticks by recomputing the current
+    words under the fresh perm.  Translated back through the permutation,
+    every tick's enter/leave pairs must equal the CPU oracle's."""
+    rng = np.random.default_rng(11)
+    s, c, n = 1, 512 if not ON_TPU else BIG_C, 300
+    w = P.words_per_row(c)
+    world = np.float32(900.0)
+    x = np.zeros((s, c), np.float32)
+    z = np.zeros((s, c), np.float32)
+    x[0, :n] = rng.uniform(0, world, n)
+    z[0, :n] = rng.uniform(0, world, n)
+    r = np.full((s, c), 70, np.float32)
+    act = np.zeros((s, c), bool)
+    act[0, :n] = True
+    oracle = CPUAOIOracle(c, "pairwise")
+
+    def resort(xh, zh):
+        keyed = np.where(act, xh, np.float32("inf"))
+        perm = np.argsort(keyed, axis=1, kind="stable")
+        take = lambda a: jnp.take_along_axis(jnp.asarray(a),
+                                             jnp.asarray(perm), axis=1)
+        words, _ = aoi_words_culled(take(xh), take(zh), take(r), take(act),
+                                    col_words=CW)
+        return perm, words
+
+    perm, prev = resort(x, z)
+    oracle.step(x[0], z[0], r[0], act[0])  # prime to the same tick-0 state
+    K = 3
+    for tick in range(1, 8):
+        dx = rng.uniform(-9, 9, (s, c)).astype(np.float32)
+        dz_ = rng.uniform(-9, 9, (s, c)).astype(np.float32)
+        x = np.clip(x + np.where(act, dx, 0), 0, world).astype(np.float32)
+        z = np.clip(z + np.where(act, dz_, 0), 0, world).astype(np.float32)
+        if tick % K == 0:
+            # re-sort: fresh perm + the PREVIOUS positions' words under it
+            perm, prev = resort(
+                np.asarray(_prevx), np.asarray(_prevz))  # noqa: F821
+        take = lambda a: jnp.take_along_axis(jnp.asarray(a),
+                                             jnp.asarray(perm), axis=1)
+        new, chg, _frac = aoi_step_culled(
+            take(x), take(z), take(r), take(act), prev, col_words=CW)
+        # device events, translated perm -> original index space
+        chg_h = np.asarray(chg)[0]
+        new_h = np.asarray(new)[0]
+        ent_w = chg_h & new_h
+        lv_w = chg_h & ~new_h
+        p = perm[0]
+        def translate(pairs):
+            return {(int(p[i]), int(p[j])) for i, j in pairs}
+        got_ent = translate(P.pairs_from_words(ent_w, c))
+        got_lv = translate(P.pairs_from_words(lv_w, c))
+        want_ent, want_lv = oracle.step(x[0], z[0], r[0], act[0])
+        assert got_ent == {tuple(e) for e in want_ent}, f"tick {tick} enter"
+        assert got_lv == {tuple(e) for e in want_lv}, f"tick {tick} leave"
+        prev = new
+        _prevx, _prevz = x.copy(), z.copy()
+    assert tick >= 2 * K  # at least two re-sorts actually exercised
 
 
 def test_nearly_sorted_order_still_exact():
