@@ -133,8 +133,8 @@ def config_matrix():
         # never recorded in two rounds); device-cadence mode finally pins
         # it down with a checksum-verified number
         Config("zipf100k", 1, 131072, 60000.0, 100.0, zipf=True,
-               n_active=100000, ticks=8, chunk=1, reps=1, cpu_ticks=1,
-               cadence="device", kernel="grid"),
+               n_active=100000, ticks=max(8, GRID_RESORT_K), chunk=1,
+               reps=1, cpu_ticks=1, cadence="device", kernel="grid"),
         # the per-chip slice of a ROW-SHARDED zipf100k on a v5e-8
         # (engine/aoi_rowshard): 16384 observer rows x 131072 candidates.
         # One space too hot for one chip partitions its interest rows over
@@ -153,9 +153,11 @@ def config_matrix():
         # variant measured slower than dense (198.9 vs 143.6 ms); the
         # fixed-order redesign measured the culled pass at ~22 ms vs dense
         # 68 ms (scripts/microbench_grid.py)
+        # ticks >= GRID_RESORT_K so the measured drain spans a full
+        # re-sort period instead of extrapolating the amortized claim
         Config("million", 64, 16384, 11314.0, 100.0,
-               ticks=8, chunk=1, reps=1, cpu_ticks=1, cadence="device",
-               kernel="grid"),
+               ticks=max(8, GRID_RESORT_K), chunk=1, reps=1, cpu_ticks=1,
+               cadence="device", kernel="grid"),
         # per-entity variable radius (asymmetric interest)
         Config("var_radius", S, CAP, WORLD, RADIUS, var_radius=True),
         # unity_demo baseline: 1 space, 1k entities, fixed radius.  The
@@ -739,9 +741,14 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
             # carry.  The next tick diffs against these words in the new
             # perm space, so events stay exact across the re-sort.  The
             # `prev` operand only forges a data dependency so chained
-            # calls serialize for the marginal measurement.
-            eps = (prev[0, 0, 0] & jnp.uint32(1)).astype(jnp.float32) * 0.0
-            perm = jnp.argsort(jnp.where(act, x + eps, jnp.float32("inf")),
+            # calls serialize for the marginal measurement: eps is 0 or
+            # 1e-30 depending on prev's live bits (not foldable, unlike
+            # the old `... * 0.0`), and adding it uniformly AFTER the
+            # where shifts every key equally -- the permutation is
+            # untouched.
+            eps = ((prev[0, 0, 0] & jnp.uint32(1)).astype(jnp.float32)
+                   * jnp.float32(1e-30))
+            perm = jnp.argsort(jnp.where(act, x, jnp.float32("inf")) + eps,
                                axis=1)
             take = lambda a: jnp.take_along_axis(a, perm, axis=1)
             sx, sz, rs, acts = take(x), take(z), take(r), take(act)
@@ -892,7 +899,13 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
         drain_resort(1)
         tf = min(drain_resort(6) for _ in range(2))
         th = min(drain_resort(3) for _ in range(2))
-        grid_resort_s = max(0.0, (tf - th) / 3)
+        grid_resort_s = (tf - th) / 3
+        # a non-positive marginal means the chained resort calls did not
+        # serialize (the forged data dependency folded away) and the
+        # amortized term below would record a fabricated zero
+        assert grid_resort_s > 0.0, (
+            f"re-sort marginal non-positive (tf={tf:.4f}s th={th:.4f}s): "
+            "resort chain failed to serialize")
 
     # CPU-oracle parity after the FIRST measured chunk: the interest words
     # are a pure function of positions (the host replays the same exact
@@ -954,11 +967,14 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
         "device_ms_per_tick": chip_s_tick * 1e3,
         "device_marginal_degenerate": degenerate,
         "overflow_ticks": overflow,
+        # an overflowed tick drops events past the caps, so the mean
+        # understates the true rate -- record that honestly
+        "events_per_tick_is_lower_bound": overflow > 0,
         "slow_path_ticks": enc_overflow,
         "slice_rows": 0,
         "exc_ship": 0,
         "mode": "device-cadence",
-"parity_checksum": f"{parity_fold:08x}",
+        "parity_checksum": f"{parity_fold:08x}",
         "parity_ok": parity_ok,
     }
     if cfg.kernel == "grid":

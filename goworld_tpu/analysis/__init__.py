@@ -1,0 +1,32 @@
+"""gwlint: repo-specific static analysis for goworld_tpu.
+
+Run as ``python -m goworld_tpu.analysis <paths>``.  Five checkers, each
+an AST pass over the tree (stdlib-only -- no jax import needed):
+
+=============  ===========================================================
+rule           invariant
+=============  ===========================================================
+host-sync      no hidden D2H sync on per-tick device paths
+dtype          pinned dtypes / no weak scalars in ops/ kernel code
+wire           msgtype enum + packet codecs + senders stay consistent
+iter-order     no set/dict-order-dependent bytes on the wire
+gate-coverage  auto-enabled branches are referenced from tests/
+=============  ===========================================================
+
+See docs/static-analysis.md for the suppression story.
+"""
+
+from __future__ import annotations
+
+from . import coverage, determinism, dtypes, host_sync, wire_protocol
+from .core import Context, Finding, Suppressions, run
+
+CHECKERS = [
+    host_sync.check,
+    dtypes.check,
+    wire_protocol.check,
+    determinism.check,
+    coverage.check,
+]
+
+__all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]

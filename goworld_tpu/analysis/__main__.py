@@ -1,0 +1,49 @@
+"""CLI entry point: ``python -m goworld_tpu.analysis <paths>``.
+
+Exit status: 0 clean, 1 findings, 2 configuration error (unparseable
+suppression file, no inputs).  Findings print as ``path:line:col:
+[rule] message`` so editors and CI annotate them directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import run
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="gwlint",
+        description="goworld_tpu repo-specific static analysis")
+    ap.add_argument("paths", nargs="+",
+                    help="files or directories to scan")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected from paths)")
+    ap.add_argument("--tests-dir", default=None,
+                    help="tests directory for gate-coverage "
+                         "(default: <root>/tests)")
+    ap.add_argument("--suppressions", default=None,
+                    help="suppression file "
+                         "(default: <root>/gwlint.suppressions)")
+    args = ap.parse_args(argv)
+
+    findings, config_errors = run(
+        args.paths, root=args.root, tests_dir=args.tests_dir,
+        suppressions=args.suppressions)
+
+    for err in config_errors:
+        print(f"gwlint: config error: {err}", file=sys.stderr)
+    for f in findings:
+        print(f.render())
+    if config_errors:
+        return 2
+    if findings:
+        print(f"gwlint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""dtype: jit/Pallas dtype discipline in ops/ kernel code.
+
+Three bug classes, all of which produce silently-wrong or silently-slow
+kernels rather than errors:
+
+* unpinned constructor dtypes (``jnp.zeros(n)``): the default dtype
+  depends on the x64 flag, and a weak f32/i32 that promotes differently
+  on TPU vs the CPU oracle breaks bit-exact parity;
+* ``.astype(float)`` / ``.astype(int)`` with python builtins: resolves to
+  a platform-dependent width;
+* bare python float literals inside Pallas kernel bodies: weak-typed
+  scalars whose promotion is decided per-op by the tracer, not pinned by
+  the author -- dtype/layout discipline in kernels is where silent perf
+  and correctness regressions hide.
+
+Scope: ops/ only (the kernel library).  Host-side numpy oracles in ops/
+are grandfathered per-file in gwlint.suppressions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, call_name, dotted
+
+RULE = "dtype"
+
+SCOPE = ("ops/",)
+
+# fresh-value constructors whose dtype defaults are x64-flag dependent;
+# value is the 0-based positional index where dtype may appear
+_CONSTRUCTORS = {
+    "jnp.zeros": 1, "jnp.ones": 1, "jnp.empty": 1, "jnp.full": 2,
+    "jnp.arange": 3,
+    "jax.numpy.zeros": 1, "jax.numpy.ones": 1, "jax.numpy.empty": 1,
+    "jax.numpy.full": 2, "jax.numpy.arange": 3,
+}
+
+_CAST_WRAPPERS = {
+    "float32", "float16", "bfloat16", "float64", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_",
+}
+
+
+def _has_dtype(node: ast.Call, pos: int) -> bool:
+    if any(kw.arg == "dtype" for kw in node.keywords):
+        return True
+    return len(node.args) > pos
+
+
+def _is_kernel(fn: ast.AST) -> bool:
+    """A Pallas kernel: named like one, or touching the pl.* API."""
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        if "kernel" in fn.name:
+            return True
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute):
+                d = dotted(node)
+                if d.startswith("pl.") or d.startswith("pallas."):
+                    return True
+    return False
+
+
+def check(ctx: Context):
+    for sf in ctx.files_matching(*SCOPE):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                pos = _CONSTRUCTORS.get(name)
+                if pos is not None and not _has_dtype(node, pos):
+                    yield Finding(
+                        RULE, sf.rel, node.lineno, node.col_offset,
+                        f"{name}(...) without an explicit dtype: the default "
+                        "is x64-flag dependent; pin it")
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args:
+                    arg = node.args[0]
+                    if isinstance(arg, ast.Name) and arg.id in ("float", "int"):
+                        yield Finding(
+                            RULE, sf.rel, node.lineno, node.col_offset,
+                            f".astype({arg.id}) uses a python builtin: width "
+                            "is platform-dependent; use an explicit jnp dtype")
+        # bare float literals inside kernel bodies
+        for fn in ast.walk(sf.tree):
+            if not _is_kernel(fn):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Constant) \
+                        and type(node.value) is float:
+                    parent = sf.parents.get(node)
+                    # step over a sign: jnp.float32(-1.0) is still a cast
+                    if isinstance(parent, ast.UnaryOp):
+                        parent = sf.parents.get(parent)
+                    # fine when it is the sole argument of an explicit cast
+                    if isinstance(parent, ast.Call):
+                        pn = call_name(parent)
+                        if pn.rsplit(".", 1)[-1] in _CAST_WRAPPERS:
+                            continue
+                    yield Finding(
+                        RULE, sf.rel, node.lineno, node.col_offset,
+                        f"bare python float {node.value!r} inside Pallas "
+                        "kernel body: weak-typed scalar; wrap in "
+                        "jnp.float32(...)")

@@ -228,7 +228,7 @@ class DispatcherService:
         # (reference: service-map-on-connect, GoWorldConnection.go:404-423)
         snap = Packet.for_msgtype(MT.MT_SRVDIS_SNAPSHOT)
         snap.append_u32(len(self.srvdis))
-        for srvid, info in self.srvdis.items():
+        for srvid, info in sorted(self.srvdis.items()):
             snap.append_varstr(srvid)
             snap.append_varstr(info)
         peer.send(snap)
@@ -392,7 +392,7 @@ class DispatcherService:
                 self._dispatch_entity_packet(eid, sp)
                 continue
             per_game.setdefault(ei.game_id, []).append(eid)
-        for gid, eids in per_game.items():
+        for gid, eids in sorted(per_game.items()):
             gp = Packet.for_msgtype(MT.MT_CALL_ENTITIES_BATCH)
             gp.append_varstr(method)
             gp.append_varbytes(args_wire)

@@ -80,7 +80,7 @@ _MAX_GAPS = 2048    # escaped chunk-index deltas per flush
 _MAX_EXC = 32768    # exception triples (tail + multi-bit words) per flush
 
 
-def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:
+def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:  # gwlint: allow[host-sync] -- host numpy helper; operates on np.unique output, never device values
     """(space_row, i, j) triples -> {space_row: (i, j) pairs}."""
     out: dict[int, np.ndarray] = {}
     if len(tri):
@@ -270,7 +270,7 @@ class AOIEngine:
 
             if self.mesh is not None:
                 dev = next(iter(self.mesh.mesh.devices.flat))
-                jax.device_put(np.zeros(8, np.float32),
+                jax.device_put(np.zeros(8, np.float32),  # gwlint: allow[host-sync] -- one-time boot probe at engine init, not per-tick
                                dev).block_until_ready()
                 if self.mesh.platform != "tpu":
                     from ..utils import gwlog
@@ -284,7 +284,7 @@ class AOIEngine:
             else:
                 import jax.numpy as jnp
 
-                jnp.zeros(8).block_until_ready()
+                jnp.zeros(8).block_until_ready()  # gwlint: allow[host-sync] -- one-time boot probe at engine init, not per-tick
                 if jax.default_backend() != "tpu":
                     # EXACTLY the kernel's interpret condition
                     # (aoi_pallas: backend != "tpu" -> interpret mode), so
@@ -563,7 +563,7 @@ class _CPUBucket(_Bucket):
     def get_prev(self, slot: int) -> np.ndarray:
         return self._oracles[slot].prev_words.copy()
 
-    def set_prev(self, slot: int, words: np.ndarray) -> None:
+    def set_prev(self, slot: int, words: np.ndarray) -> None:  # gwlint: allow[host-sync] -- CPU-backend bucket: state is already host-resident
         self._oracles[slot].prev_words = np.asarray(words, np.uint32).copy()
 
     def clear_entity(self, slot: int, entity_slot: int) -> None:
@@ -691,7 +691,7 @@ class _TPUBucket(_Bucket):
         else:
             self._unsub.add(slot)
 
-    def peek_words(self, slot: int) -> np.ndarray:
+    def peek_words(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         """Host mirror of the slot's interest words.  First call seeds the
         mirror with one device fetch (after draining any pipelined tick so
         mirror and delivered events agree); afterwards each harvest keeps it
@@ -869,7 +869,7 @@ class _TPUBucket(_Bucket):
         if self._inflight is not None:
             self._harvest()
 
-    def _harvest(self, rec=None) -> None:
+    def _harvest(self, rec=None) -> None:  # gwlint: allow[host-sync] -- THE per-tick drain point: harvests kernel outputs once per flush
         """Fetch + decode one dispatched tick's event stream and publish its
         per-slot events.  ``rec=None`` harvests (and clears) the inflight
         record."""
@@ -1065,11 +1065,11 @@ class _TPUBucket(_Bucket):
         self._h2d_cache[role] = (arr.copy(), dev)
         return dev
 
-    def get_prev(self, slot: int) -> np.ndarray:
+    def get_prev(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         self.flush()  # apply pending resets/steps before reading
         return np.asarray(self.prev[slot])
 
-    def set_prev(self, slot: int, words: np.ndarray) -> None:
+    def set_prev(self, slot: int, words: np.ndarray) -> None:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         self.flush()
         self._pending_reset.discard(slot)
         self.prev = self.prev.at[slot].set(self._jnp.asarray(words, self._jnp.uint32))

@@ -165,7 +165,8 @@ def _aoi_kernel(x_row, z_row, r_row, rid_row, x_col, z_col, prev, *outs,
     acc = jnp.zeros((ti, w), jnp.int32)
     for b in range(4):
         band = hit & (k_ids >= 8 * b) & (k_ids < 8 * (b + 1))
-        pb = jnp.where(band, jnp.exp2((k_ids - 8 * b).astype(jnp.float32)), 0.0)
+        pb = jnp.where(band, jnp.exp2((k_ids - 8 * b).astype(jnp.float32)),
+                       jnp.float32(0.0))
         byte = jax.lax.dot(mf, pb, preferred_element_type=jnp.float32)
         acc = acc | (byte.astype(jnp.int32) << (8 * b))
     _write_diff(acc, prev, *outs)

@@ -234,7 +234,7 @@ def encode_row_stream(vals, new_vals, widx, rsel, rcnt, *, w,
             exc_gidx, exc_chg, exc_new2, exc_n)
 
 
-def decode_row_stream(rowb, bitpos, woff, base_row, n_dirty, w,
+def decode_row_stream(rowb, bitpos, woff, base_row, n_dirty, w,  # gwlint: allow[host-sync] -- host-side decoder: consumes the already-drained stream
                       esc_rows, exc_gidx, exc_chg, exc_new):
     """Host-side (numpy) inverse of :func:`encode_row_stream`.
 
@@ -315,7 +315,7 @@ def _sorted_pairs(s, i, j, capacity):
     return out[np.argsort(key)]
 
 
-def expand_words_host(vals, flat_idx, capacity: int, n_spaces: int):
+def expand_words_host(vals, flat_idx, capacity: int, n_spaces: int):  # gwlint: allow[host-sync] -- host-side expansion of the drained stream
     """Host-side expansion of extracted words into per-space sorted pairs.
 
     Returns int32 array [K, 3] of (space, observer, observed), sorted
@@ -334,7 +334,7 @@ def expand_words_host(vals, flat_idx, capacity: int, n_spaces: int):
     return _sorted_pairs(s, i, j, capacity)
 
 
-def expand_classified_host(chg_vals, ent_vals, flat_idx, capacity: int,
+def expand_classified_host(chg_vals, ent_vals, flat_idx, capacity: int,  # gwlint: allow[host-sync] -- host-side expansion of the drained stream
                            n_spaces: int):
     """One-pass expansion of a classified change stream.
 

@@ -29,9 +29,9 @@ MT_LOAD_ENTITY_ANYWHERE = 21    # game -> disp: type, eid
 MT_CALL_ENTITY_METHOD = 22      # any game -> disp -> owner game
 MT_CALL_ENTITY_METHOD_FROM_CLIENT = 23  # client -> gate -> disp -> game
 MT_CALL_NIL_SPACES = 24         # broadcast to all games' nil spaces
+MT_QUERY_SPACE_GAMEID = 25      # for CreateEntityInSpace etc.
 MT_CALL_ENTITIES_BATCH = 26     # game -> disp -> games: one RPC, many eids
                                 # (grouped fanout: pubsub publish etc.)
-MT_QUERY_SPACE_GAMEID = 25      # for CreateEntityInSpace etc.
 
 # -- migration (EnterSpace) ------------------------------------------------
 MT_QUERY_SPACE_GAMEID_FOR_MIGRATE = 30

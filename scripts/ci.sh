@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Repo CI gate: style (ruff, when installed) + gwlint + tier-1 tests.
+# Mirrors .github/workflows/ci.yml; run locally before pushing.
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+fail=0
+
+# 1. ruff -- optional: the runtime container does not bake it in, and CI
+#    must not pip-install (the jax_graft toolchain image is sealed).
+if command -v ruff >/dev/null 2>&1; then
+    echo "== ruff =="
+    ruff check goworld_tpu/ tests/ bench.py || fail=1
+else
+    echo "== ruff == (not installed; skipped)"
+fi
+
+# 2. gwlint -- the repo-specific invariants (stdlib-only, always runs)
+echo "== gwlint =="
+python -m goworld_tpu.analysis goworld_tpu/ || fail=1
+
+# 3. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+echo "== tier-1 pytest =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || fail=1
+
+if [ "$fail" -ne 0 ]; then
+    echo "ci.sh: FAILED" >&2
+fi
+exit "$fail"

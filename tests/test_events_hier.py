@@ -1,0 +1,129 @@
+"""Bit-exactness of encode_row_stream's hierarchical exception selection.
+
+``exc_select='auto'`` silently switches from the flat top_k to the
+hierarchical chunk-then-element selection once the [mr * k] grid crosses
+2^20 entries (ops/events.py) -- i.e. exactly at the zipf100k/million
+scales where no small test ever ran it.  These tests pin the contract:
+
+* ``exc_select='hier'`` produces the SAME 10-tuple as ``'flat'`` at a
+  grid size past the auto threshold, and ``'auto'`` equals both there;
+* the equality holds bit for bit in the overflow regime too
+  (``exc_n > max_exc``): entries are chunk-major ascending on both
+  paths, so even a truncated prefix matches;
+* a hier-encoded stream round-trips through decode_row_stream.
+
+Inputs are synthesized directly in extract_chunks' output layout so the
+grid can be huge (2^18 rows) without materializing a 33M-word array.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+jnp = jax.numpy
+
+from goworld_tpu.ops.events import (  # noqa: E402
+    decode_row_stream,
+    encode_row_stream,
+)
+
+W = 128      # words per row (the codec's lane width)
+MR = 1 << 18  # row capacity: MR * K = 2^21 > the 2^20 auto threshold
+K = 8
+
+
+def _synth(rng, nd, *, row_stride=1, rcnt_max=K, multi_frac=0.3):
+    """Direct encode_row_stream inputs with ``nd`` dirty rows.
+
+    Layout mirrors extract_chunks output: first nd entries populated,
+    the rest rcnt=0 / widx=-1 padding.
+    """
+    rows = (np.arange(nd, dtype=np.int32) * row_stride).astype(np.int32)
+    rcnt = np.zeros(MR, np.int32)
+    rcnt[:nd] = rng.integers(1, rcnt_max + 1, nd)
+    rsel = np.zeros(MR, np.int32)
+    rsel[:nd] = rows
+    vals = np.zeros((MR, K), np.uint32)
+    new = np.zeros((MR, K), np.uint32)
+    widx = np.full((MR, K), -1, np.int32)
+    for r in range(nd):
+        c = int(rcnt[r])
+        widx[r, :c] = np.sort(rng.choice(W, c, replace=False))
+        for s in range(c):
+            nbits = 1 + int(rng.random() < multi_frac) * int(
+                rng.integers(1, 3))
+            v = 0
+            for _ in range(nbits):
+                v |= 1 << int(rng.integers(0, 32))
+            vals[r, s] = v
+            new[r, s] = v & int(rng.integers(0, 1 << 32))
+    return vals, new, widx, rsel, rcnt
+
+
+def _encode(inputs, exc_select, max_gaps=4096, max_exc=512):
+    vals, new, widx, rsel, rcnt = inputs
+    return jax.tree.map(np.asarray, encode_row_stream(
+        jnp.asarray(vals), jnp.asarray(new), jnp.asarray(widx),
+        jnp.asarray(rsel), jnp.asarray(rcnt), w=W, max_gaps=max_gaps,
+        max_exc=max_exc, exc_select=exc_select))
+
+
+def _assert_streams_equal(a, b):
+    names = ("rowb", "bitpos", "woff", "base_row", "n_esc", "esc_rows",
+             "exc_gidx", "exc_chg", "exc_new", "exc_n")
+    for name, xa, xb in zip(names, a, b):
+        assert np.array_equal(xa, xb), f"{name} differs between strategies"
+
+
+def test_hier_matches_flat_past_auto_threshold():
+    rng = np.random.default_rng(7)
+    inputs = _synth(rng, nd=200, rcnt_max=4)
+    flat = _encode(inputs, "flat")
+    hier = _encode(inputs, "hier")
+    auto = _encode(inputs, "auto")
+    assert int(flat[-1]) <= 512, "exc population must fit for this case"
+    _assert_streams_equal(hier, flat)
+    # MR * K = 2^21 > 2^20, so auto must have taken the hier branch --
+    # and taking it must not change a single byte
+    _assert_streams_equal(auto, flat)
+
+
+def test_hier_matches_flat_in_overflow():
+    rng = np.random.default_rng(8)
+    # 600 rows x rcnt=8: ~6 exception entries per row, far past max_exc
+    inputs = _synth(rng, nd=600, rcnt_max=K)
+    inputs[4][:600] = K  # force every row to full width
+    flat = _encode(inputs, "flat", max_exc=512)
+    hier = _encode(inputs, "hier", max_exc=512)
+    exc_n = int(flat[-1])
+    assert exc_n > 512, "test must exercise the overflow regime"
+    # the incomplete-stream scalar is exact and identical on both paths,
+    # and the truncated triple prefix matches bit for bit (chunk-major
+    # ascending on both paths)
+    _assert_streams_equal(hier, flat)
+
+
+def test_hier_roundtrip_through_decode():
+    rng = np.random.default_rng(9)
+    # sparse rows spread out so row-delta escapes are exercised too
+    inputs = _synth(rng, nd=300, row_stride=100, rcnt_max=4)
+    vals, new, widx, rsel, rcnt = inputs
+    (rowb, bitpos, woff, base_row, n_esc, esc_rows,
+     exc_gidx, exc_chg, exc_new, exc_n) = _encode(
+        inputs, "hier", max_gaps=4096, max_exc=4096)
+    assert int(n_esc) <= 4096 and int(exc_n) <= 4096
+    got_c, got_e, got_g = decode_row_stream(
+        rowb, bitpos, woff.astype(np.uint16), int(base_row), 300, W,
+        esc_rows, exc_gidx, exc_chg, exc_new)
+    ref = []
+    for r in range(300):
+        for s in range(int(rcnt[r])):
+            ref.append((int(rsel[r]) * W + int(widx[r, s]),
+                        int(vals[r, s]), int(vals[r, s] & new[r, s])))
+    ref.sort()
+    order = np.argsort(got_g, kind="stable")
+    assert np.array_equal(got_g[order], [g for g, _, _ in ref])
+    assert np.array_equal(got_c[order], [c for _, c, _ in ref])
+    assert np.array_equal(got_e[order], [e for _, _, e in ref])

@@ -1,0 +1,320 @@
+"""gwlint checker tests: seed violations in fixture trees, assert each
+checker reports them with the right file:line, and assert the real repo
+tree is clean under the committed suppressions.
+
+The fixture bugs are the exact classes gwlint caught in the tree (and
+which were then FIXED, not suppressed): the out-of-order MT_* pair, the
+dict-order dispatcher snapshot, the bare 0.0 in the Pallas kernel, the
+untested 'hier' auto-gate.  The repo-clean test is what pins those fixes:
+reintroduce any of them and gwlint (hence this test) fails.
+
+Stdlib-only on purpose -- these tests must run where jax is absent.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from goworld_tpu.analysis import coverage, determinism, dtypes, host_sync, \
+    wire_protocol
+from goworld_tpu.analysis.__main__ import main as gwlint_main
+from goworld_tpu.analysis.core import run
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _mk(root: Path, files: dict[str, str]) -> Path:
+    for rel, text in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    return root
+
+
+def _ln(text: str, frag: str) -> int:
+    """1-based line of the first line containing ``frag``."""
+    for i, line in enumerate(textwrap.dedent(text).splitlines(), 1):
+        if frag in line:
+            return i
+    raise AssertionError(f"fragment {frag!r} not in fixture")
+
+
+def _run(root: Path, checkers, **kw):
+    return run([str(root)], root=str(root), checkers=checkers, **kw)
+
+
+# -- host-sync ---------------------------------------------------------------
+
+HOT = """\
+    import numpy as np
+
+    def tick(x):
+        a = np.asarray(x)
+        b = x.item()
+        c = float(x)
+        d = float("3.5")
+        x.block_until_ready()
+        return a, b, c, d
+
+    def drain(x):  # gwlint: allow[host-sync] -- fixture drain point
+        return np.asarray(x)
+"""
+
+
+def test_host_sync_flags_each_sync_with_location(tmp_path):
+    _mk(tmp_path, {"ops/hot.py": HOT})
+    findings, _ = _run(tmp_path, [host_sync.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("ops/hot.py", _ln(HOT, "np.asarray(x)")),
+        ("ops/hot.py", _ln(HOT, "x.item()")),
+        ("ops/hot.py", _ln(HOT, "float(x)")),
+        ("ops/hot.py", _ln(HOT, "block_until_ready")),
+    }
+    # the def-line allow covered drain()'s body; the literal float() arg
+    # was never flagged
+    assert all(f.rule == "host-sync" for f in findings)
+
+
+def test_host_sync_out_of_scope_files_untouched(tmp_path):
+    _mk(tmp_path, {"utils/misc.py": HOT})
+    findings, _ = _run(tmp_path, [host_sync.check])
+    assert findings == []
+
+
+def test_suppression_file_grandfathers_and_demands_reason(tmp_path):
+    _mk(tmp_path, {"ops/oracle.py": HOT})
+    good = tmp_path / "gwlint.suppressions"
+    good.write_text("ops/oracle.py::host-sync -- fixture oracle\n")
+    findings, errors = _run(tmp_path, [host_sync.check],
+                            suppressions=str(good))
+    assert findings == [] and errors == []
+
+    bad = tmp_path / "bad.suppressions"
+    bad.write_text("ops/oracle.py::host-sync\n")
+    findings, errors = _run(tmp_path, [host_sync.check],
+                            suppressions=str(bad))
+    assert findings and errors and "reason" in errors[0]
+
+
+# -- dtype -------------------------------------------------------------------
+
+KERN = """\
+    import jax.numpy as jnp
+
+    def make(n):
+        z = jnp.zeros(n)
+        o = jnp.ones(n, jnp.int32)
+        return z, o
+
+    def _fma_kernel(x):
+        y = x.astype(float)
+        s = x * 0.5
+        t = x + jnp.float32(-1.0)
+        return y, s, t
+"""
+
+
+def test_dtype_unpinned_weak_and_bare_float(tmp_path):
+    _mk(tmp_path, {"ops/kern.py": KERN})
+    findings, _ = _run(tmp_path, [dtypes.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("ops/kern.py", _ln(KERN, "jnp.zeros(n)")),
+        ("ops/kern.py", _ln(KERN, "astype(float)")),
+        ("ops/kern.py", _ln(KERN, "x * 0.5")),
+    }
+    # positionally-pinned jnp.ones and the signed cast jnp.float32(-1.0)
+    # are clean
+
+
+def test_dtype_bare_floats_only_flagged_in_kernels(tmp_path):
+    _mk(tmp_path, {"ops/host_math.py": "def scale(x):\n    return x * 0.5\n"})
+    findings, _ = _run(tmp_path, [dtypes.check])
+    assert findings == []
+
+
+# -- wire --------------------------------------------------------------------
+
+MSGTYPES = """\
+    MT_A = 1
+    MT_B = 3
+    MT_C = 2
+    MT_DUP = 3
+    MT_GATE_HELLO = 1000
+    MT_REDIRECT_TO_CLIENT_BEGIN = 1001
+    MT_PUSH = 1100
+    MT_REDIRECT_TO_CLIENT_END = 1499
+    MT_STRAY = 70000
+"""
+
+PACKET = """\
+    import struct
+
+    _u16 = struct.Struct("<H")
+    _u32 = struct.Struct("<I")
+
+    class Packet:
+        @classmethod
+        def for_msgtype(cls, mt):
+            return cls()
+
+        def append_u16(self, v):
+            self.buf += _u16.pack(v)
+
+        def read_u16(self):
+            return _u32.unpack(self.buf)[0]
+
+        def append_u32(self, v):
+            self.buf += _u32.pack(v)
+
+        def read_u32(self):
+            return _u32.unpack(self.buf)[0]
+
+        def append_orphan(self, v):
+            self.buf += v
+
+        def append_client_id(self, v):
+            self.buf += v
+
+        def read_client_id(self):
+            return self.buf
+"""
+
+CONN = """\
+    class Conn:
+        def send_push_bad_prefix(self, p):
+            p = Packet.for_msgtype(MT.MT_PUSH)
+            p.append_u32(1)
+            self.send(p)
+
+        def send_push_ok(self, p):
+            p = Packet.for_msgtype(MT.MT_PUSH)
+            p.append_u16(1)
+            p.append_client_id(b"e1")
+            self.send(p)
+
+        def send_unknown_type(self):
+            p = Packet.for_msgtype(MT.MT_MISSING)
+            self.send(p)
+
+        def send_unknown_method(self):
+            p = Packet.for_msgtype(MT.MT_A)
+            p.append_nope(1)
+            self.send(p)
+"""
+
+
+def test_wire_enum_codec_and_sender_consistency(tmp_path):
+    _mk(tmp_path, {"proto/msgtypes.py": MSGTYPES,
+                   "netutil/packet.py": PACKET,
+                   "proto/connection.py": CONN})
+    findings, _ = _run(tmp_path, [wire_protocol.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        # the enum: out-of-order decl, duplicate id, band escapee
+        ("proto/msgtypes.py", _ln(MSGTYPES, "MT_C = 2")),
+        ("proto/msgtypes.py", _ln(MSGTYPES, "MT_DUP = 3")),
+        ("proto/msgtypes.py", _ln(MSGTYPES, "MT_STRAY")),
+        # the codecs: orphan append, struct-asymmetric u16 pair
+        ("netutil/packet.py", _ln(PACKET, "def append_orphan")),
+        ("netutil/packet.py", _ln(PACKET, "def append_u16")),
+        # the senders: bad redirect prefix, unknown type, unknown method
+        ("proto/connection.py", _ln(CONN, "def send_push_bad_prefix")),
+        ("proto/connection.py", _ln(CONN, "def send_unknown_type")),
+        ("proto/connection.py", _ln(CONN, "def send_unknown_method")),
+    }
+    msgs = {f.message for f in findings}
+    assert any("declared after" in m for m in msgs)
+    assert any("duplicates" in m for m in msgs)
+    assert any("append_u16(gate_id) + append_client_id" in m for m in msgs)
+
+
+# -- iter-order --------------------------------------------------------------
+
+ENC = """\
+    def snapshot(reg, p):
+        for k in {1, 2, 3}:
+            p.append_u32(k)
+        for k, v in reg.items():
+            p.append_u32(k)
+        for k, v in sorted(reg.items()):
+            p.append_u32(k)
+        total = 0
+        for k, v in reg.items():
+            total += v
+        return total
+"""
+
+
+def test_iter_order_sets_and_wire_feeding_dicts(tmp_path):
+    _mk(tmp_path, {"proto/enc.py": ENC})
+    findings, _ = _run(tmp_path, [determinism.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("proto/enc.py", _ln(ENC, "{1, 2, 3}")),
+        ("proto/enc.py", _ln(ENC, "in reg.items():")),  # first occurrence
+    }
+    # sorted(...) iteration and the non-wire accumulation loop are clean
+    assert len(findings) == 2
+
+
+# -- gate-coverage -----------------------------------------------------------
+
+GATES = """\
+    import os
+
+    def pick(n):
+        mode = "fancy" if n > (1 << 20) else "plain"
+        flag = os.environ.get("GW_UNTESTED_FLAG")
+        tested = os.getenv("GW_TESTED_FLAG")
+        return mode, flag, tested
+"""
+
+
+def test_gate_coverage_untested_modes_and_env_flags(tmp_path):
+    _mk(tmp_path, {
+        "core/gates.py": GATES,
+        "tests/test_gates.py":
+            "def test_plain():\n"
+            "    assert 'plain' and 'GW_TESTED_FLAG'\n",
+    })
+    findings, _ = _run(tmp_path, [coverage.check],
+                       tests_dir=str(tmp_path / "tests"))
+    by_msg = sorted((f.line, f.message) for f in findings
+                    if f.path == "core/gates.py")
+    assert len(by_msg) == 2
+    assert by_msg[0][0] == _ln(GATES, '"fancy"')
+    assert "'fancy'" in by_msg[0][1]
+    assert by_msg[1][0] == _ln(GATES, "GW_UNTESTED_FLAG")
+    assert "'GW_UNTESTED_FLAG'" in by_msg[1][1]
+    # 'plain' and 'GW_TESTED_FLAG' are referenced from tests/: clean
+
+
+# -- the real tree -----------------------------------------------------------
+
+def test_repo_tree_is_clean_under_committed_suppressions():
+    """Pins the fixes gwlint forced: msgtypes declaration order, sorted()
+    dispatcher snapshots, the pinned f32 scalar in the AOI kernel, and a
+    tests/ reference for the 'hier' auto-gate.  Reverting any of them
+    resurfaces a finding here."""
+    findings, errors = run([str(REPO / "goworld_tpu")], root=str(REPO))
+    assert errors == []
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = _mk(tmp_path / "clean", {"pkg/ok.py": "X = 1\n"})
+    assert gwlint_main([str(clean), "--root", str(clean)]) == 0
+
+    dirty = _mk(tmp_path / "dirty", {"ops/hot.py": HOT})
+    assert gwlint_main([str(dirty), "--root", str(dirty)]) == 1
+    out = capsys.readouterr().out
+    line = _ln(HOT, "np.asarray(x)")
+    assert f"ops/hot.py:{line}:" in out and "[host-sync]" in out
+
+    bad = tmp_path / "dirty" / "nr.suppressions"
+    bad.write_text("ops/hot.py::host-sync\n")
+    assert gwlint_main([str(dirty), "--root", str(dirty),
+                        "--suppressions", str(bad)]) == 2
