@@ -77,6 +77,36 @@ GRID_RESORT_K = int(os.environ.get("BENCH_GRID_RESORT_K", 16))
 # -- so a tight budget drops the most expensive, least load-bearing lines
 # (round 3 had it backwards and skipped zipf100k three rounds running)
 TIME_BUDGET_S = float(os.environ.get("BENCH_TIME_BUDGET_S", 1500))
+# device-memory budget for drain-loop input staging: pre-staging every
+# chunk of the giant-C configs (million: ~128 MB/chunk x 3 chunks of walk
+# deltas ON TOP of the carried words) crashed BENCH_r05 with
+# RESOURCE_EXHAUSTED; past this budget chunks stream one at a time
+STAGE_BUDGET_MB = float(os.environ.get("BENCH_DEVICE_STAGE_BUDGET_MB", 512))
+
+
+def _stage_source(stage, n_chunks, chunk_nbytes):
+    """Bounded device staging for the drain loops.
+
+    While every chunk fits the budget they are pre-staged once, so the
+    timed drain pays zero H2D (pure chip time).  Past the budget the drain
+    stages ONE chunk at a time: the next chunk's H2D is enqueued right
+    after the current dispatch (the transfer rides the wire while the chip
+    computes) and the previous chunk's buffers are dropped, so the
+    high-water staging footprint is ~2 chunks regardless of drain length.
+    Returns ``(get, mode)``; ``get(ci)`` yields chunk ci's staged tuple.
+    """
+    import jax
+
+    budget = int(STAGE_BUDGET_MB * (1 << 20))
+    assert 3 * chunk_nbytes <= budget, (
+        f"staged-chunk window (3x{chunk_nbytes / 1e6:.0f} MB) exceeds the "
+        f"device staging budget ({budget / 1e6:.0f} MB): lower BENCH_CHUNK "
+        f"or raise BENCH_DEVICE_STAGE_BUDGET_MB")
+    if n_chunks * chunk_nbytes <= budget:
+        staged = [stage(ci) for ci in range(n_chunks)]
+        jax.block_until_ready(staged)
+        return (lambda ci: staged[ci]), "prestaged"
+    return stage, "streamed"
 
 
 class Config:
@@ -505,19 +535,27 @@ def bench_tpu(cfg, qx, qz, xs, zs):
     # of pure dispatch overhead in the old full-drain numbers).  Each
     # length is best-of-N so weather can only inflate, never deflate, and
     # the difference stays clean.
-    # inputs pre-staged on device: the drain measures CHIP time; the wire's
-    # share of e2e is already visible in ms_per_tick (a colocated deployment
-    # pays PCIe for these bytes, which is negligible)
-    q_dev = [(jax.device_put(qx_meas[ci * chunk:(ci + 1) * chunk]),
-              jax.device_put(qz_meas[ci * chunk:(ci + 1) * chunk]))
-             for ci in range(n_chunks)]
-    jax.block_until_ready(q_dev)
+    # inputs staged within the device-memory budget (_stage_source): small
+    # configs pre-stage everything and the drain measures CHIP time; giant
+    # configs stream one chunk at a time (BENCH_r05's pre-stage-all crashed
+    # RESOURCE_EXHAUSTED) with the next H2D overlapping the dispatch.  The
+    # wire's share of e2e is already visible in ms_per_tick (a colocated
+    # deployment pays PCIe for these bytes, which is negligible)
+    get_q, stage_mode = _stage_source(
+        lambda ci: (jax.device_put(qx_meas[ci * chunk:(ci + 1) * chunk]),
+                    jax.device_put(qz_meas[ci * chunk:(ci + 1) * chunk])),
+        n_chunks, 2 * chunk * s * cap)
 
     def drain(n):
         t0 = time.perf_counter()
         carry = (wx, wz, wprev)
+        nxt = get_q(0)
         for ci in range(n):
-            carry, _out = run(carry[0], carry[1], carry[2], *q_dev[ci])
+            carry, _out = run(carry[0], carry[1], carry[2], *nxt)
+            if ci + 1 < n:
+                # streamed mode: enqueue the next chunk's H2D while the chip
+                # computes; rebinding nxt drops the previous chunk's buffers
+                nxt = get_q(ci + 1)
         # REAL host fetch as the sync point: on this harness
         # block_until_ready can return eagerly (CHANGES_r05 item 7), which
         # left the drain timing enqueue cost -- i.e. tunnel RTT -- instead
@@ -572,6 +610,7 @@ def bench_tpu(cfg, qx, qz, xs, zs):
         "stream_bytes_per_tick": d2h_bytes,
         "h2d_bytes_per_tick": h2d_bytes,
         "wire_MBps": round(wire_mbps, 1),
+        "drain_stage_mode": stage_mode,
     }
 
 
@@ -855,17 +894,22 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
     # MARGINAL per tick via long-minus-half drains (see bench_tpu: fixed
     # dispatch RPC cost would otherwise be billed to the chip), each length
     # best-of-N.
-    # inputs pre-staged on device (see bench_tpu.drain: chip time, not wire)
-    q_dev = [stage_q(qx_meas[ci * chunk:(ci + 1) * chunk],
-                     qz_meas[ci * chunk:(ci + 1) * chunk])
-             for ci in range(n_chunks)]
-    jax.block_until_ready(q_dev)
+    # inputs staged within the device-memory budget (see bench_tpu.drain /
+    # _stage_source: BENCH_r05's pre-stage-all of the giant-C configs
+    # crashed RESOURCE_EXHAUSTED); grid mode stages 4 arrays per chunk
+    get_q, stage_mode = _stage_source(
+        lambda ci: stage_q(qx_meas[ci * chunk:(ci + 1) * chunk],
+                           qz_meas[ci * chunk:(ci + 1) * chunk]),
+        n_chunks, (4 if cfg.kernel == "grid" else 2) * chunk * s * cap)
 
     def drain(n):
         t0 = time.perf_counter()
         carry = wcarry
+        nxt = get_q(0)
         for ci in range(n):
-            carry, _st = run(carry, *q_dev[ci])
+            carry, _st = run(carry, *nxt)
+            if ci + 1 < n:
+                nxt = get_q(ci + 1)  # overlap H2D; drop previous buffers
         # real fetch sync -- see bench_tpu.drain (eager block_until_ready)
         _ = np.asarray(carry[0][0, :4])
         return time.perf_counter() - t0
@@ -878,7 +922,7 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
     # (the same position-mixed XOR the host oracle computes).  Per-tick
     # folds were never compared beyond this point, so keeping them in the
     # hot stats only taxed every tick with a full-words pass.
-    chunk1_carry, _ = run(wcarry, *q_dev[0])
+    chunk1_carry, _ = run(wcarry, *get_q(0))
     parity_fold = int(np.asarray(jax.jit(fold_words)(chunk1_carry[-1])))
     del chunk1_carry
 
@@ -976,6 +1020,7 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
         "mode": "device-cadence",
         "parity_checksum": f"{parity_fold:08x}",
         "parity_ok": parity_ok,
+        "drain_stage_mode": stage_mode,
     }
     if cfg.kernel == "grid":
         out["grid_steady_ms_per_tick"] = t_device / ticks * 1e3
@@ -1072,7 +1117,8 @@ def _timed(fn):
     return time.perf_counter() - t0
 
 
-def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
+def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
+                 movers_frac=None, delta_staging=True):
     """Engine-level number: ``Runtime.tick`` end-to-end.
 
     Movement drive:
@@ -1096,6 +1142,13 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
     instead of serializing host->device->wire->host every tick.  Reported
     for BOTH calculators: ``cpp`` (native grid/sweep -- the compiled-Go-
     engine analog) and ``tpu``.
+
+    ``movers_frac`` switches the drive to SPARSE movement: only that
+    fraction of each space's entities moves per tick (production shape:
+    most entities idle most ticks).  This is the delta-staging showcase --
+    the same line recorded with ``delta_staging=False`` (full restage
+    every tick) is the A/B baseline; compare their ``aoi_stage_ms`` and
+    ``aoi_h2d_bytes_per_tick``.
     """
     import jax
 
@@ -1121,7 +1174,8 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
         def on_enter_aoi(self, other):  # non-plain: eager replay
             pass
 
-    rt = Runtime(aoi_backend=backend, aoi_pipeline=pipeline)
+    rt = Runtime(aoi_backend=backend, aoi_pipeline=pipeline,
+                 aoi_delta_staging=delta_staging)
     rt.entities.register(BenchScene)
     rt.entities.register(BenchMob)
     rt.entities.register(BenchWatcher)
@@ -1165,18 +1219,41 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
         ]
 
     acc = {"drive_s": 0.0, "tick_s": 0.0}
+    # sparse movement: a fresh random subset of each space's entities per
+    # tick; the unmoved rest re-stage bit-identical positions (the delta
+    # path's steady case).  Precomputed so both A/B variants walk the same.
+    move_sel = None
+    if movers_frac is not None:
+        k = max(1, int(per * movers_frac))
+        sel_rng = np.random.default_rng(17)
+        move_sel = [np.sort(sel_rng.choice(per, k, replace=False))
+                    for _ in range(ticks + warmup + max_extra)]
 
     def run_ticks(start, count, measure=False):
         for t in range(start, start + count):
             td0 = time.perf_counter()
-            pos[0] = np.clip(pos[0] + wx[t], 0, cfg.world)
-            pos[1] = np.clip(pos[1] + wz[t], 0, cfg.world)
+            if move_sel is not None:
+                sel = move_sel[t % len(move_sel)]
+                idx = (sel[None] + np.arange(cfg.s)[:, None] * per).ravel()
+                pos[0][idx] = np.clip(pos[0][idx] + wx[t][idx], 0, cfg.world)
+                pos[1][idx] = np.clip(pos[1][idx] + wz[t][idx], 0, cfg.world)
+            else:
+                pos[0] = np.clip(pos[0] + wx[t], 0, cfg.world)
+                pos[1] = np.clip(pos[1] + wz[t], 0, cfg.world)
             px, pz = pos[0], pos[1]
             if bulk:
                 for si, sp in enumerate(spaces):
                     lo = si * per
-                    sp.move_entities(slot_arrays[si], px[lo:lo + per],
-                                     pz[lo:lo + per])
+                    if move_sel is not None:
+                        sp.move_entities(slot_arrays[si][sel],
+                                         px[lo + sel], pz[lo + sel])
+                    else:
+                        sp.move_entities(slot_arrays[si], px[lo:lo + per],
+                                         pz[lo:lo + per])
+            elif move_sel is not None:
+                for i in idx:
+                    e = ents[i]
+                    e.set_position(Vector3(px[i], 0.0, pz[i]))
             else:
                 for i, e in enumerate(ents):
                     e.set_position(Vector3(px[i], 0.0, pz[i]))
@@ -1216,7 +1293,17 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
                 out[k] = out.get(k, 0.0) + v
         return out
 
+    def stats_snapshot():
+        # wire/staging counters (engine/aoi bucket .stats): cumulative H2D
+        # bytes actually shipped and delta-vs-full flush counts
+        out = {}
+        for b in rt.aoi._buckets.values():
+            for k, v in (getattr(b, "stats", None) or {}).items():
+                out[k] = out.get(k, 0) + v
+        return out
+
     perf0 = perf_snapshot()
+    stats0 = stats_snapshot()
     dt = float("inf")
     for _rep in range(reps):
         t0 = time.perf_counter()
@@ -1224,15 +1311,19 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
         dt = min(dt, time.perf_counter() - t0)
     kind = backend + ("+pipeline" if pipeline else "")
     drive = "bulk move_entities" if bulk else "per-entity set_position"
-    if watchers == 0:
+    if movers_frac is not None:
+        config = "engine_sparse"
+        kind += "+delta" if delta_staging else "+fullstage"
+    elif watchers == 0:
         config = "engine_plain"
     elif bulk:
         config = "engine_bulk"
     else:
         config = "engine"
+    moved = (len(move_sel[0]) * cfg.s if move_sel is not None else n)
     out = {
         "metric": "engine_moves_per_sec",
-        "value": round(n * ticks / dt),
+        "value": round(moved * ticks / dt),
         "unit": "moves/s",
         "rate_kind": "e2e",
         "kind": kind + ("+bulk" if bulk else ""),
@@ -1242,10 +1333,15 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
                   f"{cfg.s} spaces x {per} entities, r={cfg.radius}, "
                   f"world={cfg.world}, {watchers} watchers/space"
                   + (" (all-plain: event stream unsubscribed, scalars-only "
-                     "fetch)" if watchers == 0 else ""),
+                     "fetch)" if watchers == 0 else "")
+                  + (f", sparse drive: {moved} movers/tick"
+                     if movers_frac is not None else ""),
         "ms_per_tick": round(dt / ticks * 1e3, 2),
         "n_entities": n,
     }
+    if movers_frac is not None:
+        out["movers_frac"] = movers_frac
+        out["delta_staging"] = delta_staging
     # phase attribution, averaged over ALL measured ticks (the headline
     # number stays best-of-reps): drive = the movement API calls, bucket
     # counters split the flush into host pack/dispatch, synchronous wire
@@ -1263,6 +1359,19 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1):
                 d / total_ticks * 1e3, 2)
             other -= d
         out["host_other_ms"] = round(other / total_ticks * 1e3, 2)
+    stats1 = stats_snapshot()
+    if stats1:
+        # H2D attribution (delta staging): bytes actually shipped per tick
+        # and the fraction of flushes the sparse-packet path served
+        dflush = stats1.get("delta_flushes", 0) - stats0.get(
+            "delta_flushes", 0)
+        fflush = stats1.get("full_flushes", 0) - stats0.get(
+            "full_flushes", 0)
+        out["aoi_h2d_bytes_per_tick"] = round(
+            (stats1.get("h2d_bytes", 0) - stats0.get("h2d_bytes", 0))
+            / total_ticks)
+        out["aoi_delta_hit_rate"] = round(
+            dflush / max(dflush + fflush, 1), 3)
     return out
 
 
@@ -1460,8 +1569,15 @@ def main():
             emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True))
             # all-plain production shape (NPC farm): the space unsubscribes
             # from the event stream -- per-tick fetch is scalars-only
+            emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True,
+                              watchers=0))
+            # sparse movement (<=10% movers/tick) delta-staging A/B: same
+            # walk with the sparse-packet path on, then forced full restage
+            # -- compare aoi_stage_ms and aoi_h2d_bytes_per_tick
+            emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True,
+                              movers_frac=0.1))
             out = bench_engine(cfg, "tpu", pipeline=True, bulk=True,
-                               watchers=0)
+                               movers_frac=0.1, delta_staging=False)
         else:
             out = run_config(cfg, companion=cfg.headline)
         emit(out)
@@ -1503,8 +1619,11 @@ def main():
                          ("wire_MBps", "wire_MBps"),
                          ("auto_backend", "auto"),
                          ("drive_ms", "drive_ms"),
+                         ("aoi_stage_ms", "stage_ms"),
                          ("aoi_fetch_ms", "fetch_ms"),
                          ("aoi_calc_ms", "calc_ms"),
+                         ("aoi_h2d_bytes_per_tick", "h2d_B"),
+                         ("aoi_delta_hit_rate", "delta_hit"),
                          ("host_other_ms", "host_ms")):
             if src in o:
                 rec[dst] = o[src]

@@ -1,6 +1,6 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Five checkers, each
+Run as ``python -m goworld_tpu.analysis <paths>``.  Six checkers, each
 an AST pass over the tree (stdlib-only -- no jax import needed):
 
 =============  ===========================================================
@@ -11,6 +11,7 @@ dtype          pinned dtypes / no weak scalars in ops/ kernel code
 wire           msgtype enum + packet codecs + senders stay consistent
 iter-order     no set/dict-order-dependent bytes on the wire
 gate-coverage  auto-enabled branches are referenced from tests/
+h2d-staging    full host-array uploads ride the _h2d/delta staging seam
 =============  ===========================================================
 
 See docs/static-analysis.md for the suppression story.
@@ -18,7 +19,8 @@ See docs/static-analysis.md for the suppression story.
 
 from __future__ import annotations
 
-from . import coverage, determinism, dtypes, host_sync, wire_protocol
+from . import (coverage, determinism, dtypes, h2d_staging, host_sync,
+               wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
 CHECKERS = [
@@ -27,6 +29,7 @@ CHECKERS = [
     wire_protocol.check,
     determinism.check,
     coverage.check,
+    h2d_staging.check,
 ]
 
 __all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]

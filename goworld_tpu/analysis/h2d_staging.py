@@ -1,0 +1,85 @@
+"""h2d-staging: full host-array uploads must ride the delta-staging seam.
+
+The tick inputs (x/z/r/act/sub host shadows, ``self._h*``) are
+device-resident between flushes; a steady tick ships only a sparse update
+packet (engine/aoi ``_stage_inputs``, ops/aoi_stage.py).  That contract
+dies silently if a ``flush()`` grows a direct ``jnp.asarray(self._hx)`` /
+``device_put(self._hz)``: the full O(S*C) upload returns every tick,
+nothing crashes, and the delta machinery measures as a no-op.  PR-2 moved
+every full-array staged-input H2D into the ``_h2d`` / ``_stage_inputs`` /
+``_stage_xz`` seam precisely so this is auditable in one place; this rule
+keeps it there.
+
+Flagged: inside any function named ``flush``, an upload call
+(``jnp.asarray`` / ``jnp.array`` / ``jax.device_put`` / ``*.device_put``
+/ the local ``put`` alias) whose argument is a host shadow -- a
+``self._h*`` attribute, a slice/index of one, or a local name assigned
+from one.  Intentional sites take ``# gwlint: allow[h2d-staging]`` with a
+reason.
+
+Scope: the bucket modules (engine/aoi.py, engine/aoi_mesh.py,
+engine/aoi_rowshard.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, call_name
+
+RULE = "h2d-staging"
+
+SCOPE = ("engine/aoi.py", "engine/aoi_mesh.py", "engine/aoi_rowshard.py")
+
+_UPLOAD_NAMES = {"jnp.asarray", "jnp.array", "jax.device_put",
+                 "jax.numpy.asarray", "put"}
+
+
+def _is_shadow(node: ast.AST, shadow_locals: set[str]) -> bool:
+    """True for ``self._h<x>``, any slice/index of it, or a local bound to
+    one (``hx = self._hx; jnp.asarray(hx)``)."""
+    if isinstance(node, ast.Subscript):
+        return _is_shadow(node.value, shadow_locals)
+    if isinstance(node, ast.Attribute):
+        return node.attr.startswith("_h") and not node.attr.startswith(
+            "_h2d")
+    if isinstance(node, ast.Name):
+        return node.id in shadow_locals
+    return False
+
+
+def _is_upload(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name in _UPLOAD_NAMES:
+        return True
+    return isinstance(node.func, ast.Attribute) \
+        and node.func.attr == "device_put"
+
+
+def check(ctx: Context):
+    for sf in ctx.files_matching(*SCOPE):
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or fn.name != "flush":
+                continue
+            # local names rebound from a shadow array count as shadows too
+            shadow_locals: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and _is_shadow(node.value, shadow_locals):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            shadow_locals.add(tgt.id)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and _is_upload(node)
+                        and node.args
+                        and _is_shadow(node.args[0], shadow_locals)):
+                    continue
+                yield Finding(
+                    RULE, sf.rel, node.lineno, node.col_offset,
+                    "full host-array upload inside flush() bypasses the "
+                    "_h2d/delta staging seam (every tick pays O(S*C) H2D "
+                    "and the sparse-packet path silently degrades to a "
+                    "no-op); route it through _h2d()/_stage_inputs()/"
+                    "_stage_xz() or mark the line "
+                    "'# gwlint: allow[h2d-staging] -- <why>'")

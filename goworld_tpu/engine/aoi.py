@@ -100,7 +100,12 @@ def _fused_bucket_step(prev_all, *args):
     latency is per tick on the production path).
 
     ``args`` = (new_buf, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
-    slot_idx, x, z, r, act, max_chunks, kcap, max_gaps, max_exc).  ``chg``/``new`` and the raw
+    slot_idx, x_all, z_all, r_all, act_all, sub_all, max_chunks, kcap,
+    max_gaps, max_exc) where x_all/z_all/r_all/act_all are the bucket's
+    persistent DEVICE-RESIDENT [s_max, C] staged inputs (sub_all [s_max]);
+    the staged slots' rows are gathered by ``slot_idx`` inside the program,
+    so a delta-staged tick never re-ships unchanged inputs (see
+    ops/aoi_stage.py and _TPUBucket.flush).  ``chg``/``new`` and the raw
     grids are kept for cap-overflow recovery -- ``prev_all`` is donated, so
     the diff would otherwise be unrecoverable -- and ALL large outputs ride
     DONATED scratch buffers: returning a freshly allocated device array
@@ -122,9 +127,14 @@ def _fused_bucket_step(prev_all, *args):
             static_argnames=("max_chunks", "kcap", "max_gaps", "max_exc"),
             donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         def impl(prev_all, new_buf, chg_buf, vals_buf, nv_buf, lane_buf,
-                 csel_buf, slot_idx, x, z, r, act, sub, max_chunks, kcap,
-                 max_gaps, max_exc):
+                 csel_buf, slot_idx, x_all, z_all, r_all, act_all, sub_all,
+                 max_chunks, kcap, max_gaps, max_exc):
             prev_rows = prev_all[slot_idx]
+            x = x_all[slot_idx]
+            z = z_all[slot_idx]
+            r = r_all[slot_idx]
+            act = act_all[slot_idx]
+            sub = sub_all[slot_idx]
             # platform routing (pallas on TPU, fused dense elsewhere) lives
             # in ONE place: ops/aoi_dense.aoi_step_chg
             new, chg = aoi_step_chg(x, z, r, act, prev_rows)
@@ -227,9 +237,14 @@ class AOIEngine:
 
     def __init__(self, default_backend: str = "cpu",
                  oracle_algorithm: str = "sweep", mesh=None,
-                 pipeline: bool = False, tpu_min_capacity: int = 4096,
+                 pipeline: bool = False, delta_staging: bool = True,
+                 tpu_min_capacity: int = 4096,
                  rowshard_min_capacity: int = 65536):
         self.default_backend = default_backend
+        # sparse delta staging of device-resident tick inputs (see
+        # _TPUBucket._stage_inputs); False = full-restage baseline, kept
+        # for perf A/B in bench.py
+        self.delta_staging = delta_staging
         self.oracle_algorithm = oracle_algorithm
         # "auto" routing threshold: spaces below it go to the native host
         # calculator (a tiny space is dispatch-bound on an accelerator;
@@ -343,17 +358,20 @@ class AOIEngine:
                     # the space, never pooled)
                     from .aoi_rowshard import _RowShardTPUBucket
 
-                    bucket = _RowShardTPUBucket(capacity, self.mesh,
-                                                pipeline=self.pipeline)
+                    bucket = _RowShardTPUBucket(
+                        capacity, self.mesh, pipeline=self.pipeline,
+                        delta_staging=self.delta_staging)
                     self._rowshard_serial += 1
                     key = (f"tpu-rowshard-{self._rowshard_serial}", capacity)
                 elif self.mesh is not None:
                     from .aoi_mesh import _MeshTPUBucket
 
-                    bucket = _MeshTPUBucket(capacity, self.mesh,
-                                            pipeline=self.pipeline)
+                    bucket = _MeshTPUBucket(
+                        capacity, self.mesh, pipeline=self.pipeline,
+                        delta_staging=self.delta_staging)
                 else:
-                    bucket = _TPUBucket(capacity, pipeline=self.pipeline)
+                    bucket = _TPUBucket(capacity, pipeline=self.pipeline,
+                                        delta_staging=self.delta_staging)
             else:
                 raise ValueError(f"unknown AOI backend {backend!r}")
             self._buckets[key] = bucket
@@ -596,9 +614,11 @@ class _TPUBucket(_Bucket):
     without dispatching a new one (shutdown, state carry-over, tests).
     """
 
-    def __init__(self, capacity: int, pipeline: bool = False):
+    def __init__(self, capacity: int, pipeline: bool = False,
+                 delta_staging: bool = True):
         super().__init__(capacity)
         self.pipeline = pipeline
+        self.delta_staging = delta_staging
         self._inflight = None  # pending dispatch awaiting harvest
         # per-slot release epoch: a pipelined harvest must NOT publish
         # events for a slot released (and possibly reused) after its
@@ -642,9 +662,29 @@ class _TPUBucket(_Bucket):
         # refreshed from device on the next peek of that slot
         self._unsub: set[int] = set()
         self._mirror_stale: set[int] = set()
-        # device-resident copies of rarely-changing staged arrays, keyed by
-        # array role; re-uploaded only when the host values change
-        self._h2d_cache: dict[str, tuple] = {}
+        # delta staging (the _h2d role cache grown into full device
+        # residency): persistent HOST SHADOWS of the staged inputs
+        # [s_max, C] (+ sub [s_max]) and matching DEVICE copies in _dev.
+        # The shadow and the device copy are kept BITWISE identical --
+        # flush() diffs newly staged values against the shadow (uint32 bit
+        # patterns, so NaN payloads and -0.0/0.0 cannot silently diverge)
+        # and ships only a compact (row, col, x, z) packet
+        # (ops/aoi_stage.py); _dev_stale names the roles whose device copy
+        # no longer matches the shadow and must be fully re-uploaded
+        # (grow/reset, r/act/sub change -- the full-restage fallbacks).
+        self._hx = np.zeros((0, capacity), np.float32)
+        self._hz = np.zeros((0, capacity), np.float32)
+        self._hr = np.zeros((0, capacity), np.float32)
+        self._hact = np.zeros((0, capacity), bool)
+        self._hsub = np.ones(0, bool)
+        self._dev: dict[str, object] = {}
+        self._dev_stale: set[str] = {"xz", "ra", "sub"}
+        # delta path bails to a full restage past this changed fraction:
+        # scatter cost grows with the packet while the full upload is flat
+        self._delta_max_frac = 0.25
+        # H2D attribution (bench artifact): cumulative wire bytes actually
+        # shipped and how often the sparse-packet path won
+        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0}
         # phase-attribution counters (seconds, cumulative): stage = host
         # pack + H2D enqueue + dispatch, fetch = synchronous D2H waits,
         # decode = stream decode + event expansion.  bench_engine reads
@@ -672,11 +712,34 @@ class _TPUBucket(_Bucket):
             grown = np.zeros((new_s, self.capacity, self.W), np.uint32)
             grown[: self._mirror.shape[0]] = self._mirror
             self._mirror = grown
+        for name in ("_hx", "_hz", "_hr"):
+            arr = getattr(self, name)
+            grown = np.zeros((new_s, self.capacity), np.float32)
+            grown[: arr.shape[0]] = arr
+            setattr(self, name, grown)
+        hact = np.zeros((new_s, self.capacity), bool)
+        hact[: self._hact.shape[0]] = self._hact
+        self._hact = hact
+        hsub = np.ones(new_s, bool)
+        hsub[: self._hsub.shape[0]] = self._hsub
+        self._hsub = hsub
+        # device copies are the old shape: full restage on the next flush
+        self._dev.clear()
+        self._dev_stale = {"xz", "ra", "sub"}
         self.s_max = new_s
 
     def _reset_slot(self, slot: int) -> None:
         self._pending_reset.add(slot)
         self._unsub.discard(slot)  # subscription is per-occupant; default on
+        # the shadow must match what the next flush stages for this slot
+        # (zeros until the new occupant stages); the device copies now
+        # diverge -> full restage (the ISSUE's grow/reset fallback)
+        self._hx[slot] = 0.0
+        self._hz[slot] = 0.0
+        self._hr[slot] = 0.0
+        self._hact[slot] = False
+        self._hsub[slot] = True
+        self._dev_stale.update(("xz", "ra", "sub"))
         self._mirror_stale.discard(slot)  # mirror row is reset to truth below
         if self._mirror is not None:
             # immediate even with a tick in flight: the harvest XOR is
@@ -690,6 +753,9 @@ class _TPUBucket(_Bucket):
             self._unsub.discard(slot)
         else:
             self._unsub.add(slot)
+        if slot < self._hsub.shape[0] and self._hsub[slot] != flag:
+            self._hsub[slot] = flag
+            self._dev_stale.add("sub")
 
     def peek_words(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         """Host mirror of the slot's interest words.  First call seeds the
@@ -775,17 +841,23 @@ class _TPUBucket(_Bucket):
         t_stage0 = time.perf_counter()
         slots = sorted(self._staged)
         s_n = len(slots)
-        x = np.zeros((s_n, c), np.float32)
-        z = np.zeros((s_n, c), np.float32)
-        r = np.zeros((s_n, c), np.float32)
-        act = np.zeros((s_n, c), bool)
-        for row, slot in enumerate(slots):
+        sl = np.array(slots, np.intp)
+        # restage into the persistent host shadow; the previously staged
+        # values are saved first (fancy index -> compact copies) so
+        # _stage_inputs can diff the new tick against them
+        old_x, old_z = self._hx[sl], self._hz[sl]
+        old_r, old_act = self._hr[sl], self._hact[sl]
+        for slot in slots:
             sx, sz, sr, sa = self._staged[slot]
             n = len(sx)
-            x[row, :n] = sx
-            z[row, :n] = sz
-            r[row, :n] = sr
-            act[row, :n] = sa
+            self._hx[slot, :n] = sx
+            self._hx[slot, n:] = 0.0
+            self._hz[slot, :n] = sz
+            self._hz[slot, n:] = 0.0
+            self._hr[slot, :n] = sr
+            self._hr[slot, n:] = 0.0
+            self._hact[slot, :n] = sa
+            self._hact[slot, n:] = False
         self._staged.clear()
 
         slot_idx = jnp.asarray(slots, jnp.int32)
@@ -808,13 +880,13 @@ class _TPUBucket(_Bucket):
                 jnp.full((mc, self._kcap), -1, jnp.int32),
                 jnp.zeros(mc, jnp.int32),
             )
-        sub = np.fromiter((s not in self._unsub for s in slots),
-                          bool, s_n) if self._unsub else np.ones(s_n, bool)
+        sub = self._hsub[sl]
         if self._mirror is not None and not sub.all():
             self._mirror_stale.update(s for s in slots if s in self._unsub)
+        self._stage_inputs(sl, old_x, old_z, old_r, old_act)
         out = _fused_bucket_step(
-            self.prev, *scratch, slot_idx, jnp.asarray(x), jnp.asarray(z),
-            self._h2d("r", r), self._h2d("act", act), self._h2d("sub", sub),
+            self.prev, *scratch, slot_idx, self._dev["x"], self._dev["z"],
+            self._dev["r"], self._dev["act"], self._dev["sub"],
             mc, self._kcap, self._max_gaps, self._max_exc
         )
         (self.prev, new, chg, g_vals, g_nv, g_lane, g_csel,
@@ -1051,19 +1123,59 @@ class _TPUBucket(_Bucket):
             self._mirror[_slot, :, w] &= np.uint32(
                 ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
 
+    def _stage_inputs(self, sl, old_x, old_z, old_r, old_act) -> None:
+        """Bring the device-resident staged inputs up to date with the host
+        shadow.  The steady path ships a sparse (row, col, x, z) packet
+        applied by a donated scatter (ops/aoi_stage.py); the fallbacks ship
+        full role arrays through _h2d: after grow/reset, when r/act/sub
+        changed, when the changed fraction exceeds _delta_max_frac, or when
+        delta staging is disabled (the bench's full-restage baseline).
+
+        The diff compares float BIT PATTERNS: device copies must stay
+        byte-identical to the shadow or delta-staged ticks would diverge
+        from full-staged ones (the bit-exactness contract)."""
+        from ..ops import aoi_stage as AS
+
+        new_x, new_z = self._hx[sl], self._hz[sl]
+        diff = (new_x.view(np.uint32) != old_x.view(np.uint32)) \
+            | (new_z.view(np.uint32) != old_z.view(np.uint32))
+        n_changed = np.count_nonzero(diff)  # host numpy scalar
+        if not (np.array_equal(self._hr[sl], old_r)
+                and np.array_equal(self._hact[sl], old_act)):
+            self._dev_stale.add("ra")
+            self._dev_stale.add("xz")  # r/act change: full-restage fallback
+        stale = self._dev_stale
+        if (self.delta_staging and not stale
+                and n_changed <= self._delta_max_frac * diff.size):
+            if n_changed:
+                rows, cols = np.nonzero(diff)
+                pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
+                                    new_z[rows, cols])
+                self._dev["x"], self._dev["z"] = AS.apply_packet(
+                    self._dev["x"], self._dev["z"], *pkt)
+                self.stats["h2d_bytes"] += AS.packet_nbytes(*pkt)
+            self.stats["delta_flushes"] += 1
+            return
+        if (not self.delta_staging or "xz" in stale or n_changed
+                or "x" not in self._dev):
+            self._dev["x"] = self._h2d("x", self._hx)
+            self._dev["z"] = self._h2d("z", self._hz)
+        if "ra" in stale or "r" not in self._dev:
+            self._dev["r"] = self._h2d("r", self._hr)
+            self._dev["act"] = self._h2d("act", self._hact)
+        if "sub" in stale or "sub" not in self._dev:
+            self._dev["sub"] = self._h2d("sub", self._hsub)
+        stale.clear()
+        self.stats["full_flushes"] += 1
+
     def _h2d(self, role: str, arr: np.ndarray):
-        """Upload a staged array only when its values changed since the last
-        ship (radius/active change on enter/leave, not per move) -- the
-        cached device copy is reused otherwise."""
+        """Full upload of one shadow-backed role array -- THE seam every
+        full-array staged-input H2D rides (gwlint h2d-staging); its sparse
+        sibling is the delta packet in _stage_inputs."""
         import jax.numpy as jnp
 
-        cached = self._h2d_cache.get(role)
-        if cached is not None and cached[0].shape == arr.shape and \
-                np.array_equal(cached[0], arr):
-            return cached[1]
-        dev = jnp.asarray(arr)
-        self._h2d_cache[role] = (arr.copy(), dev)
-        return dev
+        self.stats["h2d_bytes"] += arr.nbytes
+        return jnp.asarray(arr)
 
     def get_prev(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         self.flush()  # apply pending resets/steps before reading

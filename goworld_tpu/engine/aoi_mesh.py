@@ -63,6 +63,7 @@ import numpy as np
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
 from .aoi import _Bucket, _CapDecay, _split_rows
+from ..parallel.compat import shard_map
 
 _LANES = 128
 
@@ -71,13 +72,15 @@ class _MeshTPUBucket(_Bucket):
     """Device-mesh-resident interest state [S, C, W], spaces sharded over
     the mesh's 'space' axis; one fused shard_map dispatch per flush."""
 
-    def __init__(self, capacity: int, mesh, pipeline: bool = False):
+    def __init__(self, capacity: int, mesh, pipeline: bool = False,
+                 delta_staging: bool = True):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
 
         self.mesh = mesh  # parallel.SpaceMesh
         self.n_dev = mesh.n_devices
         self.pipeline = pipeline
+        self.delta_staging = delta_staging
         self.s_max = 0
         self.prev = None  # [S, C, W] uint32, sharded over axis 0
         # host-side staged inputs, persistent: unstaged slots re-submit their
@@ -114,6 +117,16 @@ class _MeshTPUBucket(_Bucket):
         # device copies of rarely-changing staged arrays (radius, active),
         # re-uploaded only when values change
         self._h2d_cache: dict[str, tuple] = {}
+        # delta staging: persistent device-resident sharded x/z copies,
+        # bitwise-identical to the _hx/_hz shadows; steady flushes ship a
+        # replicated sparse packet each chip scatters into its own row
+        # block (no collectives).  _xz_stale = the device copies diverged
+        # (grow/reset/clear, r/act/sub change) -> full restage fallback.
+        self._dx = None
+        self._dz = None
+        self._xz_stale = True
+        self._delta_max_frac = 0.25
+        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0}
         # pipelined tick awaiting harvest
         self._inflight = None
         # per-slot release epoch: a harvest must not publish events (or XOR
@@ -171,6 +184,8 @@ class _MeshTPUBucket(_Bucket):
             self._mirror = grown
         self.s_max = new_s
         self._h2d_cache.clear()
+        self._dx = self._dz = None
+        self._xz_stale = True
         self._scratch.clear()
 
     def _reset_slot(self, slot: int) -> None:
@@ -181,6 +196,7 @@ class _MeshTPUBucket(_Bucket):
         self._hz[slot] = 0.0
         self._hr[slot] = 0.0
         self._hact[slot] = False
+        self._xz_stale = True  # device x/z diverged from the shadow
         self._seeded_unstaged.discard(slot)
         self._unsub.discard(slot)  # subscription is per-occupant; default on
         self._hsub[slot] = True
@@ -201,8 +217,9 @@ class _MeshTPUBucket(_Bucket):
             self._unsub.discard(slot)
         else:
             self._unsub.add(slot)
-        if slot < self._hsub.shape[0]:
+        if slot < self._hsub.shape[0] and self._hsub[slot] != flag:
             self._hsub[slot] = flag
+            self._xz_stale = True  # sub change: full-restage fallback
 
     def peek_words(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         if self._mirror is None:
@@ -252,6 +269,7 @@ class _MeshTPUBucket(_Bucket):
         # slot cannot re-derive the cleared pairs
         if slot < self._hact.shape[0]:
             self._hact[slot, entity_slot] = False
+            self._xz_stale = True  # act change: full-restage fallback
         if self._mirror is not None:
             if self._inflight is not None:
                 self._mirror_ops.append(
@@ -353,6 +371,71 @@ class _MeshTPUBucket(_Bucket):
             jnp.asarray([m for _, _, m in cols], jnp.uint32),
         )
 
+    def _delta_fn(self, npk: int):
+        """Jitted donated per-shard scatter of one replicated (rows, cols,
+        xv, zv) packet into the sharded device x/z: each chip localizes the
+        row indices to its own block and drops the rest
+        (ops/aoi_stage.delta_scatter) -- no cross-chip collectives.  Keyed
+        by padded packet length AND s_max (the closure bakes the block
+        size)."""
+        key = ("delta", npk, self.s_max)
+        fn = self._maint_cache.get(key)
+        if fn is None:
+            import jax
+            from jax.sharding import PartitionSpec as PS
+
+            from ..ops.aoi_stage import delta_scatter
+            from ..parallel.compat import shard_map
+
+            s_local = self.s_max // self.n_dev
+            axis = self.mesh.axis
+
+            def _local(dx, dz, rows, cols, xv, zv):
+                lo = jax.lax.axis_index(axis) * s_local
+                return delta_scatter(dx, dz, rows, cols, xv, zv,
+                                     row_lo=lo, n_rows=s_local)
+
+            spec, rep = PS(axis), PS()
+            local = shard_map(_local, mesh=self.mesh.mesh,
+                              in_specs=(spec, spec, rep, rep, rep, rep),
+                              out_specs=(spec, spec), check_vma=False)
+            self._maint_cache[key] = fn = jax.jit(
+                local, donate_argnums=(0, 1))
+        return fn
+
+    def _stage_xz(self, sl, old_x, old_z, old_r, old_act) -> None:
+        """Bring the device-resident sharded x/z up to date with the host
+        shadow: a sparse replicated packet on the steady path, a full
+        sharded re-upload on the fallbacks (grow/reset/clear, r/act/sub
+        change, changed fraction above _delta_max_frac, or delta staging
+        disabled).  Bit-pattern diff: see _TPUBucket._stage_inputs."""
+        from ..ops import aoi_stage as AS
+
+        new_x, new_z = self._hx[sl], self._hz[sl]
+        diff = (new_x.view(np.uint32) != old_x.view(np.uint32)) \
+            | (new_z.view(np.uint32) != old_z.view(np.uint32))
+        n_changed = np.count_nonzero(diff)  # host numpy scalar
+        if not (np.array_equal(self._hr[sl], old_r)
+                and np.array_equal(self._hact[sl], old_act)):
+            self._xz_stale = True  # r/act change: full-restage fallback
+        if (self.delta_staging and not self._xz_stale
+                and self._dx is not None
+                and n_changed <= self._delta_max_frac * max(diff.size, 1)):
+            if n_changed:
+                rows, cols = np.nonzero(diff)
+                pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
+                                    new_z[rows, cols])
+                self._dx, self._dz = self._delta_fn(len(pkt[0]))(
+                    self._dx, self._dz, *pkt)
+                self.stats["h2d_bytes"] += AS.packet_nbytes(*pkt)
+            self.stats["delta_flushes"] += 1
+            return
+        self._dx = self.mesh.device_put(self._hx)
+        self._dz = self.mesh.device_put(self._hz)
+        self.stats["h2d_bytes"] += self._hx.nbytes + self._hz.nbytes
+        self._xz_stale = False
+        self.stats["full_flushes"] += 1
+
     def _h2d(self, role: str, arr: np.ndarray):
         cached = self._h2d_cache.get(role)
         if cached is not None and cached[0].shape == arr.shape and \
@@ -360,6 +443,7 @@ class _MeshTPUBucket(_Bucket):
             return cached[1]
         dev = self.mesh.device_put(arr)
         self._h2d_cache[role] = (arr.copy(), dev)
+        self.stats["h2d_bytes"] += arr.nbytes
         return dev
 
     # -- the fused dispatch ------------------------------------------------
@@ -411,7 +495,7 @@ class _MeshTPUBucket(_Bucket):
                     exc_new, scalars[None])
 
         spec = PS(self.mesh.axis)
-        local = jax.shard_map(
+        local = shard_map(
             _local,
             mesh=self.mesh.mesh,
             in_specs=(spec,) * 11,
@@ -473,6 +557,11 @@ class _MeshTPUBucket(_Bucket):
             return
 
         staged_slots = sorted(self._staged)
+        sl = np.asarray(staged_slots, np.intp)
+        # save the previously staged rows (fancy index -> compact copies)
+        # before overwriting: _stage_xz diffs the new tick against them
+        old_x, old_z = self._hx[sl], self._hz[sl]
+        old_r, old_act = self._hr[sl], self._hact[sl]
         for slot in staged_slots:
             sx, sz, sr, sa = self._staged[slot]
             n = len(sx)
@@ -493,10 +582,10 @@ class _MeshTPUBucket(_Bucket):
         if self._mirror is not None and self._unsub:
             self._mirror_stale.update(
                 s for s in staged_slots if s in self._unsub)
-        put = self.mesh.device_put
         key, scratch = self._get_scratch()
+        self._stage_xz(sl, old_x, old_z, old_r, old_act)
         out = self._sharded_step()(
-            self.prev, *scratch, put(self._hx), put(self._hz),
+            self.prev, *scratch, self._dx, self._dz,
             self._h2d("r", self._hr), self._h2d("act", self._hact),
             self._h2d("sub", self._hsub))
         (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos,

@@ -49,6 +49,7 @@ import numpy as np
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
 from .aoi import _Bucket, _CapDecay
+from ..parallel.compat import shard_map
 
 _LANES = 128
 
@@ -58,7 +59,8 @@ class _RowShardTPUBucket(_Bucket):
 
     exclusive = True  # engine: one bucket per space, dropped at release
 
-    def __init__(self, capacity: int, mesh, pipeline: bool = False):
+    def __init__(self, capacity: int, mesh, pipeline: bool = False,
+                 delta_staging: bool = True):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
 
@@ -89,6 +91,17 @@ class _RowShardTPUBucket(_Bucket):
         self._maint_cache: dict[tuple, object] = {}
         self._scratch: dict[tuple, tuple] = {}
         self._h2d_cache: dict[str, tuple] = {}
+        # delta staging: persistent device copies of x/z -- one SHARDED
+        # block pair (observer rows) and one REPLICATED candidate pair --
+        # bitwise-identical to the _hx/_hz shadows.  Steady flushes ship
+        # one replicated (cols, x, z) packet; each chip scatters its own
+        # column block plus its replicated copy (no collectives).
+        self.delta_staging = delta_staging
+        self._dxs = self._dzs = None  # sharded [C]
+        self._dxr = self._dzr = None  # replicated [C]
+        self._xz_stale = True
+        self._delta_max_frac = 0.25
+        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0}
         self._pred = (512, 64, 256)
         self.full_roundtrips = 0
         self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
@@ -110,6 +123,8 @@ class _RowShardTPUBucket(_Bucket):
         pass  # fresh bucket per space: nothing to reset
 
     def set_subscribed(self, slot: int, flag: bool) -> None:
+        if self._subscribed != bool(flag):
+            self._xz_stale = True  # sub change: full-restage fallback
         self._subscribed = bool(flag)
 
     # -- device programs ----------------------------------------------------
@@ -127,7 +142,79 @@ class _RowShardTPUBucket(_Bucket):
             return cached[1]
         dev = self._replicated(arr) if replicated else self.mesh.device_put(arr)
         self._h2d_cache[role] = (arr.copy(), dev)
+        self.stats["h2d_bytes"] += arr.nbytes
         return dev
+
+    def _delta_fn(self, npk: int):
+        """Jitted donated per-shard scatter of one replicated (cols, x, z)
+        packet into BOTH device x/z copies: the sharded observer blocks
+        (column indices localized per chip, out-of-block entries dropped)
+        and the replicated candidate copies (every chip applies the whole
+        packet) -- no cross-chip collectives either way."""
+        key = ("delta", npk)
+        fn = self._maint_cache.get(key)
+        if fn is None:
+            import jax
+            from jax.sharding import PartitionSpec as PS
+
+            from ..ops.aoi_stage import delta_scatter_1d
+            from ..parallel.compat import shard_map
+
+            cl = self.c_local
+            axis = self.mesh.axis
+
+            def _local(xs, zs, xr, zr, cols, xv, zv):
+                lo = jax.lax.axis_index(axis) * cl
+                xs, zs = delta_scatter_1d(xs, zs, cols, xv, zv,
+                                          col_lo=lo, n_cols=cl)
+                xr, zr = delta_scatter_1d(xr, zr, cols, xv, zv)
+                return xs, zs, xr, zr
+
+            spec, rep = PS(axis), PS()
+            local = shard_map(_local, mesh=self.mesh.mesh,
+                              in_specs=(spec, spec, rep, rep, rep, rep, rep),
+                              out_specs=(spec, spec, rep, rep),
+                              check_vma=False)
+            self._maint_cache[key] = fn = jax.jit(
+                local, donate_argnums=(0, 1, 2, 3))
+        return fn
+
+    def _stage_xz(self, old_x, old_z, old_r, old_act) -> None:
+        """Bring the device-resident x/z copies (sharded + replicated) up
+        to date with the host shadow: sparse packet on the steady path,
+        full re-upload on the fallbacks (clear_entity, r/act/sub change,
+        changed fraction above _delta_max_frac, or delta staging
+        disabled).  Bit-pattern diff: see _TPUBucket._stage_inputs."""
+        from ..ops import aoi_stage as AS
+
+        diff = (self._hx.view(np.uint32) != old_x.view(np.uint32)) \
+            | (self._hz.view(np.uint32) != old_z.view(np.uint32))
+        n_changed = np.count_nonzero(diff)  # host numpy scalar
+        if not (np.array_equal(self._hr, old_r)
+                and np.array_equal(self._hact, old_act)):
+            self._xz_stale = True  # r/act change: full-restage fallback
+        if (self.delta_staging and not self._xz_stale
+                and self._dxs is not None
+                and n_changed <= self._delta_max_frac * diff.size):
+            if n_changed:
+                cols = np.nonzero(diff)[0]
+                _, cols, xv, zv = AS.pad_packet(cols, cols, self._hx[cols],
+                                                self._hz[cols])
+                self._dxs, self._dzs, self._dxr, self._dzr = \
+                    self._delta_fn(len(cols))(
+                        self._dxs, self._dzs, self._dxr, self._dzr,
+                        cols, xv, zv)
+                self.stats["h2d_bytes"] += \
+                    cols.nbytes + xv.nbytes + zv.nbytes
+            self.stats["delta_flushes"] += 1
+            return
+        put = self.mesh.device_put
+        self._dxs, self._dzs = put(self._hx), put(self._hz)
+        self._dxr = self._replicated(self._hx)
+        self._dzr = self._replicated(self._hz)
+        self.stats["h2d_bytes"] += 2 * (self._hx.nbytes + self._hz.nbytes)
+        self._xz_stale = False
+        self.stats["full_flushes"] += 1
 
     def _ensure_prev(self):
         if self.prev is None:
@@ -184,7 +271,7 @@ class _RowShardTPUBucket(_Bucket):
 
         spec = PS(self.mesh.axis)
         rep = PS()
-        local = jax.shard_map(
+        local = shard_map(
             _local,
             mesh=self.mesh.mesh,
             in_specs=(spec,) * 10 + (rep, rep, rep, rep),
@@ -226,7 +313,7 @@ class _RowShardTPUBucket(_Bucket):
 
         spec = PS(self.mesh.axis)
         rep = PS()
-        local = jax.shard_map(
+        local = shard_map(
             _local, mesh=self.mesh.mesh,
             in_specs=(spec, rep, rep, rep), out_specs=spec,
             check_vma=False)
@@ -243,6 +330,7 @@ class _RowShardTPUBucket(_Bucket):
         self._hz[entity_slot] = 0.0
         self._hr[entity_slot] = 0.0
         self._hact[entity_slot] = False
+        self._xz_stale = True  # device x/z diverged from the shadow
         self._h2d_cache.pop("act", None)
         self._h2d_cache.pop("r", None)
 
@@ -305,6 +393,10 @@ class _RowShardTPUBucket(_Bucket):
         t0 = time.perf_counter()
         (sx, sz, sr, sa) = self._staged.pop(0)
         n = len(sx)
+        # save the previous staged values (one [C] copy each) so _stage_xz
+        # can diff the new tick against them
+        old_x, old_z = self._hx.copy(), self._hz.copy()
+        old_r, old_act = self._hr.copy(), self._hact.copy()
         self._hx[:n] = sx
         self._hz[:n] = sz
         self._hr[:n] = sr
@@ -313,14 +405,13 @@ class _RowShardTPUBucket(_Bucket):
         self._staged.clear()
         self._ensure_prev()
         key, scratch = self._get_scratch()
-        put = self.mesh.device_put
+        self._stage_xz(old_x, old_z, old_r, old_act)
         sub = self._h2d("sub", np.asarray(self._subscribed), replicated=True)
         out = self._sharded_step()(
             self.prev, *scratch,
-            put(self._hx), put(self._hz),
+            self._dxs, self._dzs,
             self._h2d("r", self._hr), self._h2d("act", self._hact),
-            self._h2d("x_all", self._hx, replicated=True),
-            self._h2d("z_all", self._hz, replicated=True),
+            self._dxr, self._dzr,
             self._h2d("act_all", self._hact, replicated=True),
             sub)
         (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos, woff,

@@ -38,6 +38,7 @@ class Runtime:
         on_error: Callable[[BaseException], None] | None = None,
         aoi_mesh=None,
         aoi_pipeline: bool = False,
+        aoi_delta_staging: bool = True,
         aoi_tpu_min_capacity: int = 4096,
         aoi_rowshard_min_capacity: int = 65536,
     ):
@@ -48,6 +49,7 @@ class Runtime:
         self.crontab = Crontab()
         self.aoi = AOIEngine(default_backend=aoi_backend, mesh=aoi_mesh,
                              pipeline=aoi_pipeline,
+                             delta_staging=aoi_delta_staging,
                              tpu_min_capacity=aoi_tpu_min_capacity,
                              rowshard_min_capacity=aoi_rowshard_min_capacity)
         self.entities = EntityManager(self)

@@ -1,0 +1,33 @@
+"""jax version-compatibility shims for the parallel layer.
+
+The repo targets the modern ``jax.shard_map`` API (``check_vma=``), but the
+sealed runtime container may carry an older jax where shard_map still lives
+in ``jax.experimental.shard_map`` and spells the replication check
+``check_rep=``.  Every caller goes through this one seam so the version
+probe happens exactly once.
+"""
+
+from __future__ import annotations
+
+_shard_map = None
+_check_kw = None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions (new API surface)."""
+    global _shard_map, _check_kw
+    if _shard_map is None:
+        import inspect
+
+        import jax
+
+        try:
+            _shard_map = jax.shard_map
+        except AttributeError:  # jax < 0.5: experimental home
+            from jax.experimental.shard_map import shard_map as _sm
+
+            _shard_map = _sm
+        params = inspect.signature(_shard_map).parameters
+        _check_kw = "check_vma" if "check_vma" in params else "check_rep"
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_check_kw: check_vma})

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
 from ..ops.aoi_pallas import aoi_step_pallas
 from ..ops.aoi_dense import aoi_step_dense_batched
+from .compat import shard_map
 
 
 def multichip_devices(n: int | None = None):
@@ -156,7 +157,7 @@ def make_sharded_aoi_step(space_mesh: SpaceMesh, *, use_pallas: bool = True,
         ev_spec = (spec, spec, spec, spec, spec)
         out_specs = (spec, ev_spec, ev_spec, PS())
 
-    step = jax.shard_map(
+    step = shard_map(
         _local,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, spec),

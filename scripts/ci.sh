@@ -19,7 +19,12 @@ fi
 echo "== gwlint =="
 python -m goworld_tpu.analysis goworld_tpu/ || fail=1
 
-# 3. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 3. delta-staging smoke (CPU backend, few ticks: sparse packet path
+#    engages and stays bit-exact vs full restage and the oracle)
+echo "== delta smoke =="
+JAX_PLATFORMS=cpu python scripts/delta_smoke.py || fail=1
+
+# 4. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -1,0 +1,321 @@
+"""Sparse delta staging: bit-exact parity and fallback coverage.
+
+The TPU buckets keep tick inputs device-resident and ship only a sparse
+(row, col, x, z) packet on steady ticks (engine/aoi._TPUBucket._stage_inputs,
+ops/aoi_stage.py).  The contract under test:
+
+* delta-staged events are byte-identical to full-staged (delta_staging=False)
+  and to the CPU oracle -- including pipeline=True, cap growth, slot reuse,
+  unsubscribe, and clear_entity;
+* the sparse path actually engages on sparse movement (delta_flushes grows,
+  H2D bytes stay far below the full-restage baseline);
+* every invalidation -- r/act/sub mutation, grow, reset -- forces the
+  full-restage fallback and a real re-upload (the previously untested _h2d
+  seam);
+* the device copy stays BITWISE identical to the host shadow (the -0.0/NaN
+  hazard a float-equality diff would miss).
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.engine.aoi import AOIEngine
+
+
+def _scene(seed, cap, n):
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(0, 600, n).astype(np.float32)
+    zs = rng.uniform(0, 600, n).astype(np.float32)
+    rr = rng.uniform(60, 120, n).astype(np.float32)
+    act = np.zeros(cap, bool)
+    act[:n] = True
+    return rng, xs, zs, rr, act
+
+
+def _pad(a, cap):
+    o = np.zeros(cap, a.dtype)
+    o[: len(a)] = a
+    return o
+
+
+def _sparse_step(rng, xs, zs, frac=0.1):
+    """Move ~frac of the entities; everyone else stays bit-identical."""
+    movers = rng.random(len(xs)) < frac
+    xs[movers] += rng.uniform(-15, 15, int(movers.sum())).astype(np.float32)
+    zs[movers] += rng.uniform(-15, 15, int(movers.sum())).astype(np.float32)
+
+
+def _drive(engines, handles, cap, ticks, seed=7, n=180, frac=0.1,
+           state=None):
+    """Submit one identical sparse walk to every engine; return per-tick
+    events per engine key.  Pass the returned ``state`` back in to continue
+    the same walk across calls (e.g. around a stats snapshot)."""
+    rng, xs, zs, rr, act = state if state is not None \
+        else _scene(seed, cap, n)
+    out = {k: [] for k in engines}
+    for _t in range(ticks):
+        _sparse_step(rng, xs, zs, frac)
+        for k, e in engines.items():
+            e.submit(handles[k], _pad(xs, cap), _pad(zs, cap),
+                     _pad(rr, cap), act.copy())
+            e.flush()
+            out[k].append(e.take_events(handles[k]))
+    return out, (rng, xs, zs, rr, act)
+
+
+def _assert_same(out, ref="cpu", shift=0, key=None):
+    keys = [k for k in out if k != ref] if key is None else [key]
+    for k in keys:
+        for t, (re_, rl) in enumerate(out[ref][: len(out[ref]) - shift]):
+            pe, pl = out[k][t + shift]
+            np.testing.assert_array_equal(re_, pe,
+                                          err_msg=f"{k} enter tick {t}")
+            np.testing.assert_array_equal(rl, pl,
+                                          err_msg=f"{k} leave tick {t}")
+
+
+def test_delta_vs_full_vs_cpu_sparse_walk():
+    """10% movers/tick: delta and full-restage TPU engines both match the
+    oracle bit-for-bit, the delta path engages after the first (full)
+    flush, and its steady-state H2D traffic is a small fraction of the
+    baseline's."""
+    cap, ticks, n = 512, 8, 360
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu"),
+        "delta": AOIEngine(default_backend="tpu"),
+        "full": AOIEngine(default_backend="tpu", delta_staging=False),
+    }
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    db = handles["delta"].bucket
+    fb = handles["full"].bucket
+    out, st = _drive(engines, handles, cap, 1, n=n)
+    # tick 0 pays the full upload on both engines; steady state starts here
+    db0, fb0 = db.stats["h2d_bytes"], fb.stats["h2d_bytes"]
+    rest, _ = _drive(engines, handles, cap, ticks - 1, n=n, state=st)
+    for k in out:
+        out[k].extend(rest[k])
+    _assert_same(out)
+
+    assert db.stats["delta_flushes"] == ticks - 1, db.stats
+    assert db.stats["full_flushes"] == 1, db.stats
+    assert fb.stats["delta_flushes"] == 0, fb.stats
+    assert fb.stats["full_flushes"] == ticks, fb.stats
+    # steady-state wire traffic: sparse packets vs full x/z re-uploads
+    d_bytes = db.stats["h2d_bytes"] - db0
+    f_bytes = fb.stats["h2d_bytes"] - fb0
+    assert d_bytes < f_bytes / 2, (db.stats, fb.stats, d_bytes, f_bytes)
+
+
+def test_delta_device_copy_bitwise_equals_shadow():
+    """After delta flushes the device x/z must match the host shadow at the
+    BIT level -- including a 0.0 -> -0.0 flip, which float equality would
+    skip and leave silently divergent."""
+    cap, n = 128, 40
+    eng = AOIEngine(default_backend="tpu")
+    h = eng.create_space(cap)
+    rng, xs, zs, rr, act = _scene(3, cap, n)
+    xs[0] = 0.0
+    for _t in range(3):
+        _sparse_step(rng, xs, zs)
+        xs[0] = np.float32(-0.0) if _t % 2 else np.float32(0.0)
+        eng.submit(h, _pad(xs, cap), _pad(zs, cap), _pad(rr, cap),
+                   act.copy())
+        eng.flush()
+        eng.take_events(h)
+    b = h.bucket
+    assert b.stats["delta_flushes"] >= 1
+    np.testing.assert_array_equal(
+        np.asarray(b._dev["x"]).view(np.uint32), b._hx.view(np.uint32))
+    np.testing.assert_array_equal(
+        np.asarray(b._dev["z"]).view(np.uint32), b._hz.view(np.uint32))
+
+
+def test_delta_pipelined_parity_one_tick_late():
+    """pipeline=True + delta staging: bit-identical events one tick late,
+    with the sparse path still engaging."""
+    cap, ticks = 256, 6
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu"),
+        "pipe": AOIEngine(default_backend="tpu", pipeline=True),
+    }
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    out, _ = _drive(engines, handles, cap, ticks)
+    assert engines["pipe"].has_pending()
+    engines["pipe"].flush()  # trailing flush delivers the final tick
+    out["pipe"].append(engines["pipe"].take_events(handles["pipe"]))
+    assert len(out["pipe"][0][0]) == 0 and len(out["pipe"][0][1]) == 0
+    _assert_same(out, shift=1, key="pipe")
+    assert handles["pipe"].bucket.stats["delta_flushes"] >= ticks - 1
+
+
+@pytest.mark.parametrize("mutate", ["r", "act", "sub"])
+def test_h2d_invalidation_forces_full_restage(mutate):
+    """Mutating r/act/sub between ticks must force the delta path's
+    full-restage fallback AND a real re-upload (the previously untested
+    _h2d seam), with events still matching the oracle."""
+    cap, n, ticks = 256, 180, 4
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu"),
+        "tpu": AOIEngine(default_backend="tpu"),
+    }
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    rng, xs, zs, rr, act = _scene(11, cap, n)
+    b = handles["tpu"].bucket
+    out = {k: [] for k in engines}
+    for t in range(ticks):
+        _sparse_step(rng, xs, zs)
+        if t == 2:  # steady delta state reached; now invalidate
+            if mutate == "r":
+                rr[: n // 2] += 5.0
+            elif mutate == "act":
+                act[n - 5: n] = False
+            else:
+                for e, h in ((engines["cpu"], handles["cpu"]),
+                             (engines["tpu"], handles["tpu"])):
+                    e.set_subscribed(h, False)
+                    e.set_subscribed(h, True)
+            full_before = b.stats["full_flushes"]
+            bytes_before = b.stats["h2d_bytes"]
+        for k, e in engines.items():
+            e.submit(handles[k], _pad(xs, cap), _pad(zs, cap),
+                     _pad(rr, cap), act.copy())
+            e.flush()
+            out[k].append(e.take_events(handles[k]))
+    _assert_same(out)
+    assert b.stats["full_flushes"] == full_before + 1, (mutate, b.stats)
+    # the fallback re-shipped full arrays, not a sparse packet
+    assert b.stats["h2d_bytes"] - bytes_before >= b._hx.nbytes, mutate
+    assert b.stats["delta_flushes"] >= 2, b.stats  # steady path resumed
+
+
+def test_delta_slot_reuse_growth_and_clear_parity():
+    """Release + reacquire (slot reuse -> reset fallback), bucket growth,
+    and clear_entity all force full restage without breaking parity."""
+    cap, n = 128, 60
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu"),
+        "tpu": AOIEngine(default_backend="tpu"),
+    }
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    rng, xs, zs, rr, act = _scene(4, cap, n)
+    out = {k: [] for k in engines}
+
+    def tick():
+        _sparse_step(rng, xs, zs)
+        for k, e in engines.items():
+            e.submit(handles[k], _pad(xs, cap), _pad(zs, cap),
+                     _pad(rr, cap), act.copy())
+            e.flush()
+            out[k].append(e.take_events(handles[k]))
+
+    tick()
+    tick()
+    b = handles["tpu"].bucket
+    assert b.stats["delta_flushes"] >= 1
+    # clear one entity (departure): full-restage fallback, no ghost pairs
+    for k, e in engines.items():
+        e.clear_entity(handles[k], 7)
+    act[7] = False
+    full_before = b.stats["full_flushes"]
+    tick()
+    assert b.stats["full_flushes"] == full_before + 1
+    # release + reacquire: the reused slot resets -> fallback again
+    for k, e in engines.items():
+        e.release_space(handles[k])
+        handles[k] = e.create_space(cap)
+    tick()
+    tick()
+    # growth: more spaces double s_max; the first space's state survives
+    extra = {k: e.create_space(cap) for k, e in engines.items()}
+    for k, e in engines.items():
+        e.submit(extra[k], _pad(xs, cap), _pad(zs, cap), _pad(rr, cap),
+                 np.zeros(cap, bool))
+    tick()
+    tick()
+    _assert_same(out)
+
+
+def test_delta_unsubscribe_masks_and_resubscribe_recovers():
+    """Unsubscribed ticks stay silent under delta staging; resubscribing
+    resumes the stream bit-identically to the oracle."""
+    cap, n = 128, 50
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu"),
+        "tpu": AOIEngine(default_backend="tpu"),
+    }
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    rng, xs, zs, rr, act = _scene(9, cap, n)
+    for t in range(6):
+        _sparse_step(rng, xs, zs, frac=0.2)
+        if t == 2:
+            for k, e in engines.items():
+                e.set_subscribed(handles[k], False)
+        if t == 4:
+            for k, e in engines.items():
+                e.set_subscribed(handles[k], True)
+        evs = {}
+        for k, e in engines.items():
+            e.submit(handles[k], _pad(xs, cap), _pad(zs, cap),
+                     _pad(rr, cap), act.copy())
+            e.flush()
+            evs[k] = e.take_events(handles[k])
+        if t in (2, 3):
+            assert len(evs["tpu"][0]) == 0 and len(evs["tpu"][1]) == 0
+        elif t >= 5:
+            # fully resubscribed and re-synced: parity resumes.  (The CPU
+            # backend ignores subscription; the resubscribe tick itself may
+            # legitimately differ -- the TPU stream was masked while the
+            # interest state kept stepping.)
+            np.testing.assert_array_equal(evs["cpu"][0], evs["tpu"][0])
+            np.testing.assert_array_equal(evs["cpu"][1], evs["tpu"][1])
+
+
+def test_mesh_delta_sparse_walk_parity():
+    """The mesh bucket's per-shard delta packets: parity with the oracle on
+    a sparse walk, sparse path engaged, no full restage after the first."""
+    from goworld_tpu.parallel import SpaceMesh, multichip_devices
+
+    devs = multichip_devices(8)
+    if len(devs) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    cap, ticks = 256, 6
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu"),
+        "mesh": AOIEngine(default_backend="tpu", mesh=SpaceMesh(devs)),
+    }
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    out, _ = _drive(engines, handles, cap, ticks)
+    _assert_same(out)
+    mb = handles["mesh"].bucket
+    assert mb.stats["delta_flushes"] == ticks - 1, mb.stats
+    assert mb.stats["full_flushes"] == 1, mb.stats
+
+
+def test_rowshard_delta_sparse_walk_parity():
+    """The row-sharded bucket's replicated delta packets: parity on a
+    sparse walk in an oversized space, sparse path engaged."""
+    from goworld_tpu.parallel import SpaceMesh, multichip_devices
+
+    devs = multichip_devices(8)
+    if len(devs) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    cap, n, ticks = 2048, 300, 5
+    eng = AOIEngine(default_backend="tpu", mesh=SpaceMesh(devs),
+                    rowshard_min_capacity=2048)
+    oracle = AOIEngine(default_backend="cpu")
+    h = eng.create_space(cap)
+    ho = oracle.create_space(cap)
+    assert type(h.bucket).__name__ == "_RowShardTPUBucket"
+    rng, xs, zs, rr, act = _scene(13, cap, n)
+    for _t in range(ticks):
+        _sparse_step(rng, xs, zs)
+        for e, hh in ((eng, h), (oracle, ho)):
+            e.submit(hh, _pad(xs, cap), _pad(zs, cap), _pad(rr, cap),
+                     act.copy())
+            e.flush()
+        ee, el = eng.take_events(h)
+        oe, ol = oracle.take_events(ho)
+        np.testing.assert_array_equal(oe, ee, err_msg=f"enter tick {_t}")
+        np.testing.assert_array_equal(ol, el, err_msg=f"leave tick {_t}")
+    assert h.bucket.stats["delta_flushes"] == ticks - 1, h.bucket.stats
+    assert h.bucket.stats["full_flushes"] == 1, h.bucket.stats

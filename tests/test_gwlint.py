@@ -16,8 +16,8 @@ from __future__ import annotations
 import textwrap
 from pathlib import Path
 
-from goworld_tpu.analysis import coverage, determinism, dtypes, host_sync, \
-    wire_protocol
+from goworld_tpu.analysis import coverage, determinism, dtypes, \
+    h2d_staging, host_sync, wire_protocol
 from goworld_tpu.analysis.__main__ import main as gwlint_main
 from goworld_tpu.analysis.core import run
 
@@ -290,6 +290,49 @@ def test_gate_coverage_untested_modes_and_env_flags(tmp_path):
     assert by_msg[1][0] == _ln(GATES, "GW_UNTESTED_FLAG")
     assert "'GW_UNTESTED_FLAG'" in by_msg[1][1]
     # 'plain' and 'GW_TESTED_FLAG' are referenced from tests/: clean
+
+
+# -- h2d-staging -------------------------------------------------------------
+
+STAGE = """\
+    import jax.numpy as jnp
+
+    class Bucket:
+        def flush(self):
+            dx = jnp.asarray(self._hx)
+            dz = self.mesh.device_put(self._hz[sl])
+            hz = self._hz
+            dz2 = put(hz)
+            ok = self._stage_inputs(sl, self._hx[sl])
+            meta = jnp.asarray(slot_idx)
+            allowed = jnp.asarray(self._hr)  # gwlint: allow[h2d-staging] -- fixture escape
+            return dx, dz, dz2, ok, meta, allowed
+
+        def _stage_inputs(self, sl, old):
+            return jnp.asarray(self._hx)
+"""
+
+
+def test_h2d_staging_flags_flush_shadow_uploads(tmp_path):
+    _mk(tmp_path, {"engine/aoi.py": STAGE})
+    findings, _ = _run(tmp_path, [h2d_staging.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        # direct shadow upload, device_put of a shadow slice, and the
+        # local alias -- all inside flush()
+        ("engine/aoi.py", _ln(STAGE, "jnp.asarray(self._hx)")),
+        ("engine/aoi.py", _ln(STAGE, "device_put(self._hz[sl])")),
+        ("engine/aoi.py", _ln(STAGE, "put(hz)")),
+    }
+    # the seam call itself, the non-shadow slot_idx upload, the allow[]
+    # escape, and _stage_inputs (the seam, not flush) are all clean
+    assert all(f.rule == "h2d-staging" for f in findings)
+
+
+def test_h2d_staging_out_of_scope_files_untouched(tmp_path):
+    _mk(tmp_path, {"ops/stage_helper.py": STAGE})
+    findings, _ = _run(tmp_path, [h2d_staging.check])
+    assert findings == []
 
 
 # -- the real tree -----------------------------------------------------------
