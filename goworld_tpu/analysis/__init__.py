@@ -1,26 +1,29 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Six checkers, each
+Run as ``python -m goworld_tpu.analysis <paths>``.  Seven checkers, each
 an AST pass over the tree (stdlib-only -- no jax import needed):
 
-=============  ===========================================================
-rule           invariant
-=============  ===========================================================
-host-sync      no hidden D2H sync on per-tick device paths
-dtype          pinned dtypes / no weak scalars in ops/ kernel code
-wire           msgtype enum + packet codecs + senders stay consistent
-iter-order     no set/dict-order-dependent bytes on the wire
-gate-coverage  auto-enabled branches are referenced from tests/
-h2d-staging    full host-array uploads ride the _h2d/delta staging seam
-=============  ===========================================================
+===================  =====================================================
+rule                 invariant
+===================  =====================================================
+host-sync            no hidden D2H sync on per-tick device paths
+dtype                pinned dtypes / no weak scalars in ops/ kernel code
+wire                 msgtype enum + packet codecs + senders stay consistent
+iter-order           no set/dict-order-dependent bytes on the wire
+gate-coverage        auto-enabled branches are referenced from tests/
+h2d-staging          full host-array uploads ride the _h2d/delta staging
+                     seam
+fault-seam-coverage  declared fault seams are checked in package code and
+                     exercised from tests/
+===================  =====================================================
 
 See docs/static-analysis.md for the suppression story.
 """
 
 from __future__ import annotations
 
-from . import (coverage, determinism, dtypes, h2d_staging, host_sync,
-               wire_protocol)
+from . import (coverage, determinism, dtypes, fault_seams, h2d_staging,
+               host_sync, wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
 CHECKERS = [
@@ -30,6 +33,7 @@ CHECKERS = [
     determinism.check,
     coverage.check,
     h2d_staging.check,
+    fault_seams.check,
 ]
 
 __all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]

@@ -1,0 +1,146 @@
+"""fault-seam-coverage: every declared fault seam is real and tested.
+
+The fault-injection story (goworld_tpu/faults.py + docs/robustness.md)
+only holds if the seam catalog stays honest.  Three ways it rots:
+
+* a seam is declared in ``SEAMS`` but no test ever injects through it --
+  the recovery path behind it ships untested (the exact bug class
+  gate-coverage exists for, specialised to fault seams);
+* production code calls ``faults.check("...")`` with a name the catalog
+  does not declare -- the fault never fires (``FaultSpec.__post_init__``
+  rejects unknown seams at plan-build time, so the plan cannot even name
+  it) and the docstring table lies;
+* a seam is declared but no production code checks it -- dead catalog.
+
+Mechanics mirror gate-coverage: the catalog is AST-extracted from
+faults.py (the ``SEAMS = {...}`` dict's string keys), usage is every
+string literal passed as the first argument to a ``*.check(...)`` /
+``*.filter(...)`` call on a ``faults``-named object, and "tested" is a
+word-boundary text match over tests/*.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Context, Finding
+
+RULE = "fault-seam-coverage"
+
+
+def _declared_seams(sf) -> dict[str, int]:
+    """SEAMS dict string keys -> declaration line, from faults.py."""
+    out: dict[str, int] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "SEAMS" in targets:
+                for key in node.value.keys:
+                    if isinstance(key, ast.Constant) \
+                            and isinstance(key.value, str):
+                        out[key.value] = key.lineno
+    return out
+
+
+def _seam_arg(node: ast.Call) -> str | None:
+    """The seam literal of a faults.check/filter call, if that's what this
+    is.  Matches ``faults.check("x")``, ``faults.filter("x", v)`` and the
+    plan-level ``plan.add("x", ...)`` / ``self._plan.check("x")`` spellings
+    used in tests -- anything whose attr is check/filter/add with a string
+    first arg counts as naming a seam."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    if node.func.attr not in ("check", "filter"):
+        return None
+    if not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _script_usage_text(ctx: Context) -> str:
+    """Repo-root scripts (bench.py, scripts/*.py) are seam users too but
+    usually sit outside the linted paths; their text keeps root-level seams
+    like ``bench.config`` from reading as dead catalog entries."""
+    chunks = []
+    lint_roots = {sf.abspath for sf in ctx.files}
+    candidates = []
+    try:
+        for name in sorted(os.listdir(ctx.root)):
+            if name.endswith(".py"):
+                candidates.append(os.path.join(ctx.root, name))
+    except OSError:
+        pass
+    scripts = os.path.join(ctx.root, "scripts")
+    if os.path.isdir(scripts):
+        for name in sorted(os.listdir(scripts)):
+            if name.endswith(".py"):
+                candidates.append(os.path.join(scripts, name))
+    for p in candidates:
+        if p in lint_roots:
+            continue
+        try:
+            with open(p, encoding="utf-8") as fh:
+                chunks.append(fh.read())
+        except OSError:
+            pass
+    return "\n".join(chunks)
+
+
+def check(ctx: Context):
+    catalog_files = ctx.files_matching("faults.py")
+    catalog_files = [sf for sf in catalog_files
+                     if sf.rel.endswith("goworld_tpu/faults.py")
+                     or sf.rel == "faults.py"]
+    if not catalog_files:
+        return
+    cat_sf = catalog_files[0]
+    declared = _declared_seams(cat_sf)
+    if not declared:
+        return
+
+    # every faults.check/filter seam literal in package code (outside the
+    # catalog module itself and outside tests/)
+    used: dict[str, tuple[str, int]] = {}
+    for sf in ctx.files:
+        if sf is cat_sf or sf.rel.startswith("tests/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                seam = _seam_arg(node)
+                if seam is None:
+                    continue
+                if seam not in used:
+                    used[seam] = (sf.rel, node.lineno)
+                if seam not in declared:
+                    yield Finding(
+                        RULE, sf.rel, node.lineno, node.col_offset,
+                        f"fault seam {seam!r} is not declared in the "
+                        "faults.SEAMS catalog: no plan can name it, so this "
+                        "check never fires")
+
+    if ctx.tests_dir is not None:
+        for seam, line in sorted(declared.items()):
+            if not ctx.tests_reference(seam):
+                yield Finding(
+                    RULE, cat_sf.rel, line, 0,
+                    f"declared fault seam {seam!r} is never referenced from "
+                    "tests/: the recovery path behind it ships untested")
+
+    script_text = None
+    for seam, line in sorted(declared.items()):
+        if seam in used:
+            continue
+        if script_text is None:
+            script_text = _script_usage_text(ctx)
+        if re.search(r"""(?:check|filter)\(\s*['"]"""
+                     + re.escape(seam) + r"""['"]""", script_text):
+            continue
+        yield Finding(
+            RULE, cat_sf.rel, line, 0,
+            f"declared fault seam {seam!r} is checked nowhere in package "
+            "code: dead catalog entry")

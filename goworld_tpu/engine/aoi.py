@@ -35,6 +35,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .. import faults
 from ..ops import aoi_predicate as P
 from ..ops.aoi_oracle import CPUAOIOracle
 from ..ops import events as EV
@@ -78,6 +79,44 @@ def _batched_clear(prev_all, row_slots, row_ents, col_slots, col_words,
 _LANES = 128
 _MAX_GAPS = 2048    # escaped chunk-index deltas per flush
 _MAX_EXC = 32768    # exception triples (tail + multi-bit words) per flush
+
+
+def _device_fault(e: BaseException) -> bool:
+    """Classify an exception as a device-side fault the bucket should
+    recover from (vs a logic bug that must propagate).  Injected faults are
+    explicit; real jax runtime errors are matched by type name (no jaxlib
+    import) and by the canonical XLA status prefixes."""
+    if isinstance(e, faults.InjectedFault):
+        return True
+    if type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError"):
+        return True
+    msg = str(e)
+    return "RESOURCE_EXHAUSTED" in msg or "ALLOCATION" in msg.upper()
+
+
+def _packed_predicate(x, z, r, act, block: int = 2048) -> np.ndarray:  # gwlint: allow[host-sync] -- pure host numpy on the durable copies (recovery path), never device values
+    """Host recomputation of one slot's packed interest words [C, W] --
+    bit-exact with every device backend (all evaluate the same f32
+    predicate; ops/aoi_predicate).  Blocked over observer rows so the
+    boolean matrix never materializes at O(C^2) bytes (17 GB at the
+    row-sharded C=131072)."""
+    c = x.shape[0]
+    out = np.empty((c, P.words_per_row(c)), np.uint32)
+    xx = np.asarray(x, np.float32)
+    zz = np.asarray(z, np.float32)
+    rr = np.asarray(r, np.float32)
+    aa = np.asarray(act, bool)
+    for lo in range(0, c, block):
+        hi = min(lo + block, c)
+        dx = np.abs(xx[None, :] - xx[lo:hi, None])
+        dz = np.abs(zz[None, :] - zz[lo:hi, None])
+        rad = rr[lo:hi, None]
+        m = (dx <= rad) & (dz <= rad)
+        m &= aa[lo:hi, None] & aa[None, :]
+        idx = np.arange(lo, hi)
+        m[idx - lo, idx] = False  # self-interest excluded, like the kernel
+        out[lo:hi] = P.pack_rows(m)
+    return out
 
 
 def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:  # gwlint: allow[host-sync] -- host numpy helper; operates on np.unique output, never device values
@@ -124,11 +163,12 @@ def _fused_bucket_step(prev_all, *args):
 
         @functools.partial(
             jax.jit,
-            static_argnames=("max_chunks", "kcap", "max_gaps", "max_exc"),
+            static_argnames=("max_chunks", "kcap", "max_gaps", "max_exc",
+                             "platform"),
             donate_argnums=(0, 1, 2, 3, 4, 5, 6))
         def impl(prev_all, new_buf, chg_buf, vals_buf, nv_buf, lane_buf,
                  csel_buf, slot_idx, x_all, z_all, r_all, act_all, sub_all,
-                 max_chunks, kcap, max_gaps, max_exc):
+                 max_chunks, kcap, max_gaps, max_exc, platform=None):
             prev_rows = prev_all[slot_idx]
             x = x_all[slot_idx]
             z = z_all[slot_idx]
@@ -136,8 +176,12 @@ def _fused_bucket_step(prev_all, *args):
             act = act_all[slot_idx]
             sub = sub_all[slot_idx]
             # platform routing (pallas on TPU, fused dense elsewhere) lives
-            # in ONE place: ops/aoi_dense.aoi_step_chg
-            new, chg = aoi_step_chg(x, z, r, act, prev_rows)
+            # in ONE place: ops/aoi_dense.aoi_step_chg.  ``platform`` is the
+            # calculator fallback chain's override: a bucket demoted off the
+            # pallas path after a kernel failure forces the dense route
+            # (bit-identical results; docs/robustness.md)
+            new, chg = aoi_step_chg(x, z, r, act, prev_rows,
+                                    platform=platform)
             prev_all = prev_all.at[slot_idx].set(new)
             # subscription mask: slots with no event consumers (all-plain
             # spaces -- their interest state lives in the packed words,
@@ -682,9 +726,30 @@ class _TPUBucket(_Bucket):
         # delta path bails to a full restage past this changed fraction:
         # scatter cost grows with the packet while the full upload is flat
         self._delta_max_frac = 0.25
+        # -- fault tolerance (docs/robustness.md) ------------------------
+        # With a fault plan active the mirror is kept EAGERLY from slot 0:
+        # it is the durable copy of the interest state the rebuild path
+        # re-uploads after a device loss.  (Without a plan it stays lazy --
+        # no behavior change for fault-free runs; a real device fault then
+        # recovers via a best-effort prev fetch / shadow recompute.)
+        self._ft = faults.active()
+        self._need_rebuild = False   # device prev dropped; re-upload next flush
+        # calculator fallback chain: 0 = platform default (pallas on TPU),
+        # 1 = dense formulation, 2 = host oracle (device never touched).
+        # Each kernel-phase fault demotes one level; reset_calc_chain()
+        # re-arms the device path.
+        self._calc_level = 0
+        self._fault_phase = "stage"
+        self._cur_slots: list[int] = []
         # H2D attribution (bench artifact): cumulative wire bytes actually
-        # shipped and how often the sparse-packet path won
-        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0}
+        # shipped and how often the sparse-packet path won.  The fault
+        # counters ride along: rebuilds = device-state drops recovered from
+        # the durable copy, fallbacks = calculator demotions, host_ticks =
+        # ticks computed by the host oracle (recovery or level-2 mode),
+        # poisoned = control-scalar corruptions caught by validation.
+        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
+                      "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
+                      "poisoned": 0, "calc_level": 0}
         # phase-attribution counters (seconds, cumulative): stage = host
         # pack + H2D enqueue + dispatch, fetch = synchronous D2H waits,
         # decode = stream decode + event expansion.  bench_engine reads
@@ -704,14 +769,43 @@ class _TPUBucket(_Bucket):
         new_s = max(1, self.s_max)
         while new_s < n_slots:
             new_s *= 2
-        new_prev = jnp.zeros((new_s, self.capacity, self.W), jnp.uint32)
-        if self.prev is not None and self.s_max > 0:
-            new_prev = new_prev.at[: self.s_max].set(self.prev)
-        self.prev = new_prev
+        if self._need_rebuild or self._calc_level >= 2:
+            # device copy is already down: the mirror below is the durable
+            # copy and grows host-side; the next rebuild uploads it grown
+            self.prev = None
+        else:
+            try:
+                faults.check("aoi.grow")
+                new_prev = jnp.zeros((new_s, self.capacity, self.W),
+                                     jnp.uint32)
+                if self.prev is not None and self.s_max > 0:
+                    new_prev = new_prev.at[: self.s_max].set(self.prev)
+                self.prev = new_prev
+            except Exception as e:
+                if not _device_fault(e):
+                    raise
+                # allocation of the GROWN state failed; the old prev is
+                # intact, so the durable copy seeds exactly, then grows
+                # host-side with the rest of this method
+                self._ensure_mirror()
+                self.stats["rebuilds"] += 1
+                self.prev = None
+                self._need_rebuild = True
+                from ..utils import gwlog
+
+                gwlog.logger("gw.aoi").warning(
+                    "bucket grow to %d slots hit a device fault (%s); "
+                    "state held in the host mirror until the next flush "
+                    "rebuilds", new_s, e)
         if self._mirror is not None:
             grown = np.zeros((new_s, self.capacity, self.W), np.uint32)
             grown[: self._mirror.shape[0]] = self._mirror
             self._mirror = grown
+        elif self._ft:
+            # fault-tolerant mode keeps the durable copy from the start
+            # (a fresh bucket's interest state is all-zero, so no fetch)
+            self._mirror = np.zeros((new_s, self.capacity, self.W),
+                                    np.uint32)
         for name in ("_hx", "_hz", "_hr"):
             arr = getattr(self, name)
             grown = np.zeros((new_s, self.capacity), np.float32)
@@ -784,7 +878,14 @@ class _TPUBucket(_Bucket):
             # delivered events agree.
             self.flush()
             self.drain()
-            self._mirror[slot] = np.asarray(self.prev[slot])
+            if self.prev is not None:
+                self._mirror[slot] = np.asarray(self.prev[slot])
+            else:
+                # device down (rebuild pending / oracle mode): the slot's
+                # prev equals the predicate of its last staged inputs
+                self._mirror[slot] = _packed_predicate(
+                    self._hx[slot], self._hz[slot], self._hr[slot],
+                    self._hact[slot])
             self._mirror_stale.discard(slot)
         return self._mirror[slot]
 
@@ -795,9 +896,24 @@ class _TPUBucket(_Bucket):
             if self._inflight is not None:
                 self._harvest()
             return
+        if self._calc_level >= 2:
+            # calculator fallback chain bottom: host-oracle mode -- the
+            # device is gone, every tick computes from the durable copies
+            self._flush_oracle()
+            return
+        try:
+            self._flush_device()
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self._recover(e)
+
+    def _flush_device(self) -> None:
         import jax.numpy as jnp
 
         c = self.capacity
+        self._fault_phase = "stage"
+        self._rebuild_device()
         if self._pending_reset:
             idx = jnp.asarray(sorted(self._pending_reset), jnp.int32)
             self.prev = self.prev.at[idx].set(jnp.uint32(0))
@@ -847,18 +963,8 @@ class _TPUBucket(_Bucket):
         # _stage_inputs can diff the new tick against them
         old_x, old_z = self._hx[sl], self._hz[sl]
         old_r, old_act = self._hr[sl], self._hact[sl]
-        for slot in slots:
-            sx, sz, sr, sa = self._staged[slot]
-            n = len(sx)
-            self._hx[slot, :n] = sx
-            self._hx[slot, n:] = 0.0
-            self._hz[slot, :n] = sz
-            self._hz[slot, n:] = 0.0
-            self._hr[slot, :n] = sr
-            self._hr[slot, n:] = 0.0
-            self._hact[slot, :n] = sa
-            self._hact[slot, n:] = False
-        self._staged.clear()
+        self._restage_shadows()
+        self._cur_slots = slots  # recovery needs them once _staged is gone
 
         slot_idx = jnp.asarray(slots, jnp.int32)
         n_chunks_total = s_n * c * self.W // _LANES
@@ -884,10 +990,13 @@ class _TPUBucket(_Bucket):
         if self._mirror is not None and not sub.all():
             self._mirror_stale.update(s for s in slots if s in self._unsub)
         self._stage_inputs(sl, old_x, old_z, old_r, old_act)
+        self._fault_phase = "kernel"
+        faults.check("aoi.kernel")
         out = _fused_bucket_step(
             self.prev, *scratch, slot_idx, self._dev["x"], self._dev["z"],
             self._dev["r"], self._dev["act"], self._dev["sub"],
-            mc, self._kcap, self._max_gaps, self._max_exc
+            mc, self._kcap, self._max_gaps, self._max_exc,
+            "cpu" if self._calc_level >= 1 else None
         )
         (self.prev, new, chg, g_vals, g_nv, g_lane, g_csel,
          rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg, exc_new,
@@ -941,12 +1050,205 @@ class _TPUBucket(_Bucket):
         if self._inflight is not None:
             self._harvest()
 
+    # -- fault recovery (docs/robustness.md) -----------------------------
+    #
+    # The durable copies are the host shadows (_hx/_hz/_hr/_hact/_hsub --
+    # bitwise identical to the device inputs by the delta-staging contract)
+    # plus the mirror (the XOR-maintained host copy of the packed interest
+    # words).  On any device-side fault the bucket (1) delivers the tick
+    # already in flight (its buffers predate the fault), (2) recomputes the
+    # faulted tick on the host from (mirror, shadows) -- the host predicate
+    # is bit-exact with every device backend, and np.nonzero's ascending
+    # flat order matches the device chunk extraction's, so the recovered
+    # event stream is bit-identical -- and (3) drops all device state; the
+    # next flush re-uploads prev from the mirror and full-restages inputs.
+
+    def _restage_shadows(self) -> list[int]:
+        """Copy staged tick inputs into the persistent host shadows (pure
+        host work; shared by the device path and fault recovery)."""
+        slots = sorted(self._staged)
+        for slot in slots:
+            sx, sz, sr, sa = self._staged[slot]
+            n = len(sx)
+            self._hx[slot, :n] = sx
+            self._hx[slot, n:] = 0.0
+            self._hz[slot, :n] = sz
+            self._hz[slot, n:] = 0.0
+            self._hr[slot, :n] = sr
+            self._hr[slot, n:] = 0.0
+            self._hact[slot, :n] = sa
+            self._hact[slot, n:] = False
+        self._staged.clear()
+        return slots
+
+    def _rebuild_device(self) -> None:
+        """Re-upload the packed interest state from the durable host mirror
+        after a device loss (deferred to flush so a dead device is retried
+        at tick cadence, not in the failure handler)."""
+        if not self._need_rebuild:
+            return
+        self._need_rebuild = False
+        self.prev = self._jnp.asarray(self._mirror)
+        self.stats["h2d_bytes"] += self._mirror.nbytes
+
+    def reset_calc_chain(self) -> None:
+        """Re-arm the device calculator after fallback (operator action --
+        demotion is sticky so a flapping device cannot oscillate)."""
+        self._calc_level = 0
+        self.stats["calc_level"] = 0
+        if self.prev is None and self.s_max:
+            self._ensure_mirror()
+            self._need_rebuild = True
+
+    def _ensure_mirror(self) -> None:  # gwlint: allow[host-sync] -- fault-recovery path, not the steady tick
+        """Make the host mirror exist.  Fault-tolerant buckets keep it from
+        slot 0; otherwise seed it from the still-live device prev, or -- if
+        the device is truly dead -- recompute from the input shadows (exact
+        for every slot whose prev equals the predicate of its last staged
+        inputs; seeded-then-unstepped slots lose their seed, loudly)."""
+        if self._mirror is not None:
+            return
+        try:
+            self._mirror = (
+                np.zeros((self.s_max, self.capacity, self.W), np.uint32)
+                if self.prev is None
+                else np.array(self.prev, np.uint32, copy=True, order="C"))
+        except Exception:
+            from ..utils import gwlog
+
+            gwlog.logger("gw.aoi").warning(
+                "device prev unreadable during recovery; rebuilding the "
+                "mirror from the input shadows (derived state of cleared/"
+                "seeded slots may lag until their next stage)")
+            m = np.empty((self.s_max, self.capacity, self.W), np.uint32)
+            for s in range(self.s_max):
+                m[s] = _packed_predicate(self._hx[s], self._hz[s],
+                                         self._hr[s], self._hact[s])
+            self._mirror = m
+
+    def _refresh_stale_rows(self) -> None:
+        """Recompute mirror rows that went stale while unsubscribed: a
+        slot's prev equals the predicate of its last staged inputs (its
+        shadows), so the recompute is exact up to post-stage clears
+        (documented limitation; resubscription resyncs)."""
+        for s in sorted(self._mirror_stale):
+            self._mirror[s] = _packed_predicate(
+                self._hx[s], self._hz[s], self._hr[s], self._hact[s])
+        self._mirror_stale.clear()
+
+    def _recover(self, e: BaseException) -> None:
+        """Device fault mid-flush: deliver the inflight tick, recompute the
+        faulted tick host-side (bit-exact), drop device state."""
+        from ..utils import gwlog
+
+        self.stats["rebuilds"] += 1
+        if self._fault_phase == "kernel" and self._calc_level < 2:
+            # the calculator itself failed: demote one level down the
+            # chain (pallas -> dense -> host oracle)
+            self._calc_level += 1
+            self.stats["fallbacks"] += 1
+            self.stats["calc_level"] = self._calc_level
+        gwlog.logger("gw.aoi").warning(
+            "AOI bucket (cap %d) device fault during %s: %s -- recovering "
+            "tick on host (calc level %d)",
+            self.capacity, self._fault_phase, e, self._calc_level)
+        # 1. the tick dispatched LAST flush finished before this fault; its
+        # buffers are intact, so it delivers on its normal schedule
+        if self._inflight is not None:
+            try:
+                self._harvest()
+            except Exception as he:  # the device died mid-harvest too
+                gwlog.logger("gw.aoi").warning(
+                    "inflight tick unharvestable during recovery (%s); "
+                    "its events are lost", he)
+                self._inflight = None
+        # 2. make the durable copy exist, and land any maintenance that
+        # never reached the device (idempotent re-apply otherwise)
+        self._ensure_mirror()
+        for s in sorted(self._pending_reset):
+            self._mirror_apply_now(("reset", s))
+        for s, ent in self._pending_clear:
+            self._mirror_apply_now(("clear", s, ent))
+        self._pending_reset.clear()
+        self._pending_clear.clear()
+        # 3. the faulted tick's inputs are (or now land) in the shadows
+        slots = self._restage_shadows() if self._staged else self._cur_slots
+        self._cur_slots = []
+        # 4. device state is gone; the next flush rebuilds from the mirror
+        self.prev = None
+        self._dev.clear()
+        self._dev_stale = {"xz", "ra", "sub"}
+        self._scratch.clear()
+        self._need_rebuild = self._calc_level < 2
+        # 5. compute the faulted tick on the host
+        if slots:
+            self._host_tick(slots)
+
+    def _host_tick(self, slots: list[int]) -> None:
+        """One bucket tick on the host from the durable copies, bit-exact
+        with the device step: new = predicate(shadows) per staged slot,
+        chg = new XOR mirror (masked for unsubscribed slots), and the
+        event stream in np.nonzero's ascending flat order -- exactly the
+        device chunk-extraction order (the cap-overflow recovery path in
+        _harvest decodes the same way)."""
+        c, W = self.capacity, self.W
+        s_n = len(slots)
+        self.stats["host_ticks"] += 1
+        self._refresh_stale_rows()
+        sl = np.array(slots, np.intp)
+        sub = self._hsub[sl]
+        new = np.empty((s_n, c, W), np.uint32)
+        for i, s in enumerate(slots):
+            new[i] = _packed_predicate(self._hx[s], self._hz[s],
+                                       self._hr[s], self._hact[s])
+        chg = new ^ self._mirror[sl]
+        chg[~sub] = 0
+        flat = chg.reshape(-1)
+        gidx = np.nonzero(flat)[0]
+        chg_vals = flat[gidx]
+        ent_vals = chg_vals & new.reshape(-1)[gidx]
+        self._mirror[sl] = new
+        epochs = [self._slot_epoch.get(s, 0) for s in slots]
+        if self.pipeline:
+            # pipelined cadence: events are delivered one tick late, so a
+            # recovered tick parks as a synthetic inflight record and
+            # publishes at the NEXT flush, exactly like a device tick
+            self._inflight = {"host": True, "slots": slots,
+                              "epochs": epochs,
+                              "payload": (chg_vals, ent_vals, gidx, s_n)}
+        else:
+            self._publish(slots, epochs, chg_vals, ent_vals, gidx, s_n)
+
+    def _flush_oracle(self) -> None:
+        """Level-2 fallback flush: the device is out of the loop entirely;
+        maintenance already reached the mirror (its device queues just
+        drain) and every staged tick computes host-side."""
+        self._pending_reset.clear()
+        self._pending_clear.clear()
+        if not self._staged:
+            if self._inflight is not None:
+                self._harvest()
+            return
+        slots = self._restage_shadows()
+        if self._inflight is not None:
+            self._harvest()  # deliver T-1 before parking T (cadence)
+        self._host_tick(slots)
+
     def _harvest(self, rec=None) -> None:  # gwlint: allow[host-sync] -- THE per-tick drain point: harvests kernel outputs once per flush
         """Fetch + decode one dispatched tick's event stream and publish its
         per-slot events.  ``rec=None`` harvests (and clears) the inflight
         record."""
         if rec is None:
             rec, self._inflight = self._inflight, None
+        if rec.get("host"):
+            # synthetic record parked by fault recovery / oracle mode: the
+            # events were computed host-side at its tick; only the
+            # pipelined one-tick-late delivery remained
+            chg_vals, ent_vals, gidx, s_n = rec["payload"]
+            self._publish(rec["slots"], rec["epochs"], chg_vals, ent_vals,
+                          gidx, s_n)
+            self._apply_deferred_mirror_ops()
+            return
         slots, s_n, mc = rec["slots"], rec["s_n"], rec["mc"]
         kcap = rec["kcap"]
         c = self.capacity
@@ -957,16 +1259,45 @@ class _TPUBucket(_Bucket):
         # pays a round trip when the chip is reached over a network tunnel);
         # under the pipeline it was issued async at dispatch and is local by
         # now
+        faults.check("aoi.fetch")  # stallable: a delayed host sync
         t_f0 = time.perf_counter()
+        poisoned = False
         if rec.get("all_unsub"):
             nd = mcc = base_row = n_esc = exc_n = 0
         else:
-            nd, mcc, base_row, n_esc, exc_n = (int(v) for v in
-                                               np.asarray(rec["scalars"]))
-        shrink = self._caps.observe(nd, mcc, self._max_chunks, self._kcap)
+            raw = faults.filter("aoi.scalars", np.asarray(rec["scalars"]))
+            nd, mcc, base_row, n_esc, exc_n = (int(v) for v in raw)
+            nw = s_n * c * self.W
+            if not (0 <= nd <= nw // _LANES and 0 <= mcc <= _LANES
+                    and 0 <= n_esc <= nw and 0 <= exc_n <= nw
+                    and 0 <= base_row <= nw // _LANES):
+                # garbage control scalars (a kernel writing NaN-adjacent
+                # junk): distrust the encoded stream wholesale and recover
+                # this tick from the raw diff grids riding the same record
+                from ..utils import gwlog
+
+                self.stats["poisoned"] += 1
+                gwlog.logger("gw.aoi").warning(
+                    "AOI control scalars failed validation "
+                    "(nd=%d mcc=%d base=%d esc=%d exc=%d); recovering the "
+                    "tick from the raw diff grids", nd, mcc, base_row,
+                    n_esc, exc_n)
+                poisoned = True
+                nd = mcc = base_row = n_esc = exc_n = 0
+        shrink = (None if poisoned else
+                  self._caps.observe(nd, mcc, self._max_chunks, self._kcap))
         if shrink is not None:
             self._max_chunks, self._kcap = shrink
-        if nd == 0 and exc_n == 0:
+        if poisoned:
+            # full-diff recovery (same shape as the cap-overflow branch,
+            # without growing the caps off corrupted values)
+            chg_h = np.asarray(chg).reshape(-1)
+            new_h = np.asarray(new).reshape(-1)
+            gidx = np.nonzero(chg_h)[0]
+            chg_vals = chg_h[gidx]
+            ent_vals = chg_vals & new_h[gidx]
+            self.perf["fetch_s"] += time.perf_counter() - t_f0
+        elif nd == 0 and exc_n == 0:
             # quiet tick (or every staged slot unsubscribed): the stream is
             # empty by construction -- the scalars above are the ONLY fetch
             chg_vals = np.empty(0, np.uint32)
@@ -1056,25 +1387,37 @@ class _TPUBucket(_Bucket):
                                                            chg_vals[keep])
                 srows = np.asarray(slots, np.int64)[g // wps]
                 self._mirror.reshape(self.s_max, wps)[srows, g % wps] ^= v
-            if self._mirror_ops:
-                # clears issued after this tick's dispatch apply now, AFTER
-                # its stream (see _mirror_apply).  Applied directly: the
-                # NEXT tick may already be in flight, and re-deferring would
-                # postpone them forever.  The epoch tag drops ops whose slot
-                # was released since queueing -- a reacquired slot may carry
-                # freshly seeded words (set_prev) the dead occupant's clear
-                # must not touch.
-                ops, self._mirror_ops = self._mirror_ops, []
-                for op in ops:
-                    if self._slot_epoch.get(op[1], 0) == op[-1]:
-                        self._mirror_apply_now(op[:-1])
+            self._apply_deferred_mirror_ops()
         # the harvested scratch set returns to the pool for reuse
         self._scratch.setdefault(rec["key"], rec["scratch"])
-        pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx, c, s_n)
+        self._publish(slots, rec["epochs"], chg_vals, ent_vals, gidx, s_n)
+        self.perf["decode_s"] += time.perf_counter() - t_f0
+
+    def _apply_deferred_mirror_ops(self) -> None:
+        """Clears issued after a tick's dispatch apply now, AFTER its
+        stream (see _mirror_apply).  Applied directly: the NEXT tick may
+        already be in flight, and re-deferring would postpone them forever.
+        The epoch tag drops ops whose slot was released since queueing -- a
+        reacquired slot may carry freshly seeded words (set_prev) the dead
+        occupant's clear must not touch."""
+        if not self._mirror_ops:
+            return
+        ops, self._mirror_ops = self._mirror_ops, []
+        for op in ops:
+            if self._slot_epoch.get(op[1], 0) == op[-1]:
+                self._mirror_apply_now(op[:-1])
+
+    def _publish(self, slots, epochs, chg_vals, ent_vals, gidx,
+                 s_n: int) -> None:
+        """Expand a classified change stream into per-slot (enter, leave)
+        pair arrays and merge them into the deliverable events (shared by
+        the device harvest and the host-recovery tick)."""
+        pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx,
+                                           self.capacity, s_n)
         ent_rows = _split_rows(pe)
         lv_rows = _split_rows(pl)
         empty = np.empty((0, 2), np.int32)
-        for row, (slot, epoch) in enumerate(zip(slots, rec["epochs"])):
+        for row, (slot, epoch) in enumerate(zip(slots, epochs)):
             if self._slot_epoch.get(slot, 0) != epoch:
                 # slot released (and possibly reused) since this tick was
                 # dispatched: its events belong to a dead space
@@ -1090,7 +1433,6 @@ class _TPUBucket(_Bucket):
                 e = np.concatenate([pend[0], e])
                 l = np.concatenate([pend[1], l])
             self._events[slot] = (e, l)
-        self.perf["decode_s"] += time.perf_counter() - t_f0
 
     def release_slot(self, slot: int) -> None:
         self._slot_epoch[slot] = self._slot_epoch.get(slot, 0) + 1
@@ -1148,6 +1490,7 @@ class _TPUBucket(_Bucket):
         if (self.delta_staging and not stale
                 and n_changed <= self._delta_max_frac * diff.size):
             if n_changed:
+                faults.check("aoi.delta")
                 rows, cols = np.nonzero(diff)
                 pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
                                     new_z[rows, cols])
@@ -1174,18 +1517,26 @@ class _TPUBucket(_Bucket):
         sibling is the delta packet in _stage_inputs."""
         import jax.numpy as jnp
 
+        faults.check("aoi.h2d")
         self.stats["h2d_bytes"] += arr.nbytes
         return jnp.asarray(arr)
 
     def get_prev(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         self.flush()  # apply pending resets/steps before reading
+        if self.prev is None:  # device down: the mirror IS the state
+            self._ensure_mirror()
+            return np.array(self._mirror[slot], copy=True)
         return np.asarray(self.prev[slot])
 
     def set_prev(self, slot: int, words: np.ndarray) -> None:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         self.flush()
         self._pending_reset.discard(slot)
-        self.prev = self.prev.at[slot].set(self._jnp.asarray(words, self._jnp.uint32))
+        w = np.asarray(words, np.uint32)
+        if self.prev is not None:
+            self.prev = self.prev.at[slot].set(self._jnp.asarray(w))
+        else:  # device down: seed the durable copy; rebuild uploads it
+            self._ensure_mirror()
         self._mirror_stale.discard(slot)  # mirror row set to truth below
         if self._mirror is not None:
-            self._mirror[slot] = np.asarray(words, np.uint32)
+            self._mirror[slot] = w
 

@@ -60,9 +60,11 @@ import time
 
 import numpy as np
 
+from .. import faults
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import _Bucket, _CapDecay, _split_rows
+from .aoi import (_Bucket, _CapDecay, _device_fault, _packed_predicate,
+                  _split_rows)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -126,7 +128,17 @@ class _MeshTPUBucket(_Bucket):
         self._dz = None
         self._xz_stale = True
         self._delta_max_frac = 0.25
-        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0}
+        # fault tolerance (see engine/aoi._TPUBucket and docs/robustness.md):
+        # under an active plan the mirror is kept eagerly from slot 0 so a
+        # device loss always has a durable copy to rebuild from
+        self._ft = faults.active()
+        self._need_rebuild = False
+        self._calc_level = 0  # 0 = platform default, 1 = dense, 2 = oracle
+        self._fault_phase = "stage"
+        self._cur_slots: list[int] = []
+        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
+                      "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
+                      "poisoned": 0, "calc_level": 0}
         # pipelined tick awaiting harvest
         self._inflight = None
         # per-slot release epoch: a harvest must not publish events (or XOR
@@ -177,11 +189,36 @@ class _MeshTPUBucket(_Bucket):
         if self.prev is not None and self.s_max > 0:
             prev_h[: self.s_max] = np.asarray(self.prev)
             self.full_roundtrips += 1
-        self.prev = self.mesh.device_put(prev_h)
+        if self._need_rebuild or self._calc_level >= 2:
+            # device copy is already down: the mirror below is the durable
+            # copy and grows host-side; the next rebuild uploads it grown
+            self.prev = None
+        else:
+            try:
+                faults.check("aoi.grow")
+                self.prev = self.mesh.device_put(prev_h)
+            except Exception as e:
+                if not _device_fault(e):
+                    raise
+                from ..utils import gwlog
+
+                gwlog.logger("gw.aoi").warning(
+                    "mesh AOI bucket grow to %d slots failed on device "
+                    "(%s); keeping the host copy, rebuild at next flush", new_s, e)
+                self.stats["rebuilds"] += 1
+                if self._mirror is None:
+                    self._mirror = prev_h  # the growth copy becomes durable
+                self.prev = None
+                self._need_rebuild = True
         if self._mirror is not None:
-            grown = np.zeros((new_s, self.capacity, self.W), np.uint32)
-            grown[: self._mirror.shape[0]] = self._mirror
-            self._mirror = grown
+            if self._mirror.shape[0] != new_s:
+                grown = np.zeros((new_s, self.capacity, self.W), np.uint32)
+                grown[: self._mirror.shape[0]] = self._mirror
+                self._mirror = grown
+        elif self._ft:
+            # prev_h already holds the pre-growth words (zeros for fresh
+            # slots): it IS the durable copy under a fault plan
+            self._mirror = prev_h
         self.s_max = new_s
         self._h2d_cache.clear()
         self._dx = self._dz = None
@@ -239,7 +276,14 @@ class _MeshTPUBucket(_Bucket):
             # rows from device truth (one [C, W] slice, on demand)
             self.flush()
             self.drain()
-            self._mirror[slot] = np.asarray(self.prev[slot])
+            if self.prev is not None:
+                self._mirror[slot] = np.asarray(self.prev[slot])
+            else:
+                # device down (rebuild pending / oracle mode): the slot's
+                # prev equals the predicate of its last staged inputs
+                self._mirror[slot] = _packed_predicate(
+                    self._hx[slot], self._hz[slot], self._hr[slot],
+                    self._hact[slot])
             self._mirror_stale.discard(slot)
         return self._mirror[slot]
 
@@ -247,6 +291,9 @@ class _MeshTPUBucket(_Bucket):
     def get_prev(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         self.flush()
         self.drain()
+        if self.prev is None:  # device down: the mirror IS the state
+            self._ensure_mirror()
+            return np.array(self._mirror[slot], copy=True)
         return np.asarray(self.prev[slot])
 
     def set_prev(self, slot: int, words: np.ndarray) -> None:
@@ -254,9 +301,12 @@ class _MeshTPUBucket(_Bucket):
         self.drain()
         self._pending_reset.discard(slot)
         words = np.ascontiguousarray(words, np.uint32)
-        self.prev = self._set_slot_fn()(self.prev,
-                                        np.int32(slot),
-                                        words)
+        if self.prev is not None:
+            self.prev = self._set_slot_fn()(self.prev,
+                                            np.int32(slot),
+                                            words)
+        else:  # device down: seed the durable copy; rebuild uploads it
+            self._ensure_mirror()
         self._seeded_unstaged.add(slot)
         self._mirror_stale.discard(slot)  # mirror row set to truth below
         if self._mirror is not None:
@@ -422,6 +472,7 @@ class _MeshTPUBucket(_Bucket):
                 and self._dx is not None
                 and n_changed <= self._delta_max_frac * max(diff.size, 1)):
             if n_changed:
+                faults.check("aoi.delta")
                 rows, cols = np.nonzero(diff)
                 pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
                                     new_z[rows, cols])
@@ -430,6 +481,7 @@ class _MeshTPUBucket(_Bucket):
                 self.stats["h2d_bytes"] += AS.packet_nbytes(*pkt)
             self.stats["delta_flushes"] += 1
             return
+        faults.check("aoi.h2d")
         self._dx = self.mesh.device_put(self._hx)
         self._dz = self.mesh.device_put(self._hz)
         self.stats["h2d_bytes"] += self._hx.nbytes + self._hz.nbytes
@@ -441,6 +493,7 @@ class _MeshTPUBucket(_Bucket):
         if cached is not None and cached[0].shape == arr.shape and \
                 np.array_equal(cached[0], arr):
             return cached[1]
+        faults.check("aoi.h2d")
         dev = self.mesh.device_put(arr)
         self._h2d_cache[role] = (arr.copy(), dev)
         self.stats["h2d_bytes"] += arr.nbytes
@@ -452,7 +505,7 @@ class _MeshTPUBucket(_Bucket):
         static config (s_max, caps).  All large outputs ride DONATED scratch
         buffers (see engine/aoi._fused_bucket_step for why)."""
         key = (self.s_max, self._max_chunks, self._kcap, self._max_gaps,
-               self._max_exc)
+               self._max_exc, self._calc_level)
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
@@ -464,7 +517,9 @@ class _MeshTPUBucket(_Bucket):
 
         from ..ops.aoi_dense import aoi_step_chg
 
-        platform = self.mesh.platform
+        # calculator fallback chain level 1: force the fused dense path
+        # even where the platform default would pick Pallas
+        platform = "cpu" if self._calc_level >= 1 else self.mesh.platform
         mc, kcap = self._max_chunks, self._kcap
         mg, mx = self._max_gaps, self._max_exc
 
@@ -529,15 +584,30 @@ class _MeshTPUBucket(_Bucket):
         )
         return key, sc
 
-    def flush(self) -> None:  # gwlint: allow[host-sync] -- flush epilogue hands results to the harvest drain
+    def flush(self) -> None:
         if (not self._staged and not self._pending_reset
                 and not self._pending_clear):
             if self._inflight is not None:
                 self._harvest()
             return
+        if self._calc_level >= 2:
+            # calculator fallback chain bottom: host-oracle mode -- the
+            # device is gone, every tick computes from the durable copies
+            self._flush_oracle()
+            return
+        try:
+            self._flush_device()
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self._recover(e)
+
+    def _flush_device(self) -> None:  # gwlint: allow[host-sync] -- flush epilogue hands results to the harvest drain
         t0 = time.perf_counter()
+        self._fault_phase = "stage"
         if self.pipeline and self._inflight is not None \
-                and not self._inflight.get("all_unsub"):
+                and not self._inflight.get("all_unsub") \
+                and not self._inflight.get("host"):
             # peek the inflight tick's scalars (async-fetched at its
             # dispatch, host-local by now): a ROW overflow recovery reads
             # the NEW interest words, i.e. self.prev -- which maintenance
@@ -550,6 +620,7 @@ class _MeshTPUBucket(_Bucket):
             mc_i, kcap_i = self._inflight["caps"][:2]
             if (nd_mcc[:, 0] > mc_i).any() or (nd_mcc[:, 1] > kcap_i).any():
                 self._harvest()
+        self._rebuild_device()
         self._apply_maintenance()
         if not self._staged:
             if self._inflight is not None:
@@ -562,16 +633,8 @@ class _MeshTPUBucket(_Bucket):
         # before overwriting: _stage_xz diffs the new tick against them
         old_x, old_z = self._hx[sl], self._hz[sl]
         old_r, old_act = self._hr[sl], self._hact[sl]
-        for slot in staged_slots:
-            sx, sz, sr, sa = self._staged[slot]
-            n = len(sx)
-            self._hx[slot, :n] = sx
-            self._hz[slot, :n] = sz
-            self._hr[slot, :n] = sr
-            self._hact[slot] = False
-            self._hact[slot, :n] = sa
-            self._seeded_unstaged.discard(slot)
-        self._staged.clear()
+        self._restage_shadows()
+        self._cur_slots = staged_slots  # recovery needs them once _staged is gone
         if self._seeded_unstaged:
             raise RuntimeError(
                 "mesh AOI bucket: slots %r carry seeded interest state but "
@@ -584,6 +647,8 @@ class _MeshTPUBucket(_Bucket):
                 s for s in staged_slots if s in self._unsub)
         key, scratch = self._get_scratch()
         self._stage_xz(sl, old_x, old_z, old_r, old_act)
+        self._fault_phase = "kernel"
+        faults.check("aoi.kernel")
         out = self._sharded_step()(
             self.prev, *scratch, self._dx, self._dz,
             self._h2d("r", self._hr), self._h2d("act", self._hact),
@@ -649,9 +714,229 @@ class _MeshTPUBucket(_Bucket):
         if self._inflight is not None:
             self._harvest()
 
+    # -- fault recovery (see engine/aoi._TPUBucket and docs/robustness.md):
+    # the durable copies are the host shadows plus the mirror; on a device
+    # fault the in-flight tick delivers first (its buffers predate the
+    # fault), the faulted tick recomputes host-side from (mirror, shadows)
+    # -- bit-exact with the sharded step because every backend evaluates
+    # the same packed predicate and np.nonzero's ascending flat order
+    # matches the per-chip chunk extraction after the chip-offset shift --
+    # and all device state drops for a mirror re-upload at the next flush.
+
+    def _restage_shadows(self) -> list[int]:
+        """Copy staged tick inputs into the persistent host shadows (pure
+        host work; shared by the device path and fault recovery)."""
+        slots = sorted(self._staged)
+        for slot in slots:
+            sx, sz, sr, sa = self._staged[slot]
+            n = len(sx)
+            self._hx[slot, :n] = sx
+            self._hz[slot, :n] = sz
+            self._hr[slot, :n] = sr
+            self._hact[slot] = False
+            self._hact[slot, :n] = sa
+            self._seeded_unstaged.discard(slot)
+        self._staged.clear()
+        return slots
+
+    def _rebuild_device(self) -> None:
+        """Re-upload the packed interest state from the durable host mirror
+        after a device loss (deferred to flush so a dead mesh is retried at
+        tick cadence, not in the failure handler)."""
+        if not self._need_rebuild:
+            return
+        self._need_rebuild = False
+        self.prev = self.mesh.device_put(self._mirror)
+        self.stats["h2d_bytes"] += self._mirror.nbytes
+        self.full_roundtrips += 1
+
+    def reset_calc_chain(self) -> None:
+        """Re-arm the device calculator after fallback (operator action --
+        demotion is sticky so a flapping device cannot oscillate)."""
+        self._calc_level = 0
+        self.stats["calc_level"] = 0
+        if self.prev is None and self.s_max:
+            self._ensure_mirror()
+            self._need_rebuild = True
+
+    def _ensure_mirror(self) -> None:  # gwlint: allow[host-sync] -- fault-recovery path, not the steady tick
+        """Make the host mirror exist (see _TPUBucket._ensure_mirror)."""
+        if self._mirror is not None:
+            return
+        try:
+            self._mirror = (
+                np.zeros((self.s_max, self.capacity, self.W), np.uint32)
+                if self.prev is None
+                else np.array(self.prev, np.uint32, copy=True, order="C"))
+            if self.prev is not None:
+                self.full_roundtrips += 1
+        except Exception:
+            from ..utils import gwlog
+
+            gwlog.logger("gw.aoi").warning(
+                "mesh prev unreadable during recovery; rebuilding the "
+                "mirror from the input shadows (derived state of cleared/"
+                "seeded slots may lag until their next stage)")
+            m = np.empty((self.s_max, self.capacity, self.W), np.uint32)
+            for s in range(self.s_max):
+                m[s] = _packed_predicate(self._hx[s], self._hz[s],
+                                         self._hr[s], self._hact[s])
+            self._mirror = m
+
+    def _refresh_stale_rows(self) -> None:
+        """Recompute mirror rows that went stale while unsubscribed (see
+        _TPUBucket._refresh_stale_rows for the exactness contract)."""
+        for s in sorted(self._mirror_stale):
+            self._mirror[s] = _packed_predicate(
+                self._hx[s], self._hz[s], self._hr[s], self._hact[s])
+        self._mirror_stale.clear()
+
+    def _recover(self, e: BaseException) -> None:
+        """Device fault mid-flush: deliver the inflight tick, recompute the
+        faulted tick host-side (bit-exact), drop all device state."""
+        from ..utils import gwlog
+
+        self.stats["rebuilds"] += 1
+        if self._fault_phase == "kernel" and self._calc_level < 2:
+            # the calculator itself failed: demote one level down the
+            # chain (pallas -> dense -> host oracle)
+            self._calc_level += 1
+            self.stats["fallbacks"] += 1
+            self.stats["calc_level"] = self._calc_level
+        gwlog.logger("gw.aoi").warning(
+            "mesh AOI bucket (cap %d) device fault during %s: %s -- "
+            "recovering tick on host (calc level %d)",
+            self.capacity, self._fault_phase, e, self._calc_level)
+        # 1. the tick dispatched LAST flush finished before this fault; its
+        # buffers are intact, so it delivers on its normal schedule
+        if self._inflight is not None:
+            try:
+                self._harvest()
+            except Exception as he:  # the device died mid-harvest too
+                gwlog.logger("gw.aoi").warning(
+                    "inflight tick unharvestable during recovery (%s); "
+                    "its events are lost", he)
+                self._inflight = None
+        # 2. make the durable copy exist, and land any maintenance that
+        # never reached the device (resets/clears already hit the mirror
+        # when they were issued, so the re-apply is idempotent)
+        self._ensure_mirror()
+        for s in sorted(self._pending_reset):
+            self._mirror[s] = 0
+        for s, ent in self._pending_clear:
+            self._mirror_clear(s, ent)
+        self._pending_reset.clear()
+        self._pending_clear.clear()
+        # 3. the faulted tick's inputs are (or now land) in the shadows
+        slots = self._restage_shadows() if self._staged else self._cur_slots
+        self._cur_slots = []
+        # 4. device state is gone; the next flush rebuilds from the mirror
+        self.prev = None
+        self._dx = self._dz = None
+        self._xz_stale = True
+        self._h2d_cache.clear()
+        self._scratch.clear()
+        self._need_rebuild = self._calc_level < 2
+        # 5. compute the faulted tick on the host (staged slots only:
+        # unstaged slots re-step identical inputs -> zero diff by the
+        # module contract, so they emit nothing either way)
+        if slots:
+            self._host_tick(slots)
+
+    def _host_tick(self, slots: list[int]) -> None:
+        """One bucket tick on the host from the durable copies, bit-exact
+        with the sharded step (see _TPUBucket._host_tick)."""
+        c, W = self.capacity, self.W
+        s_n = len(slots)
+        self.stats["host_ticks"] += 1
+        self._refresh_stale_rows()
+        sl = np.array(slots, np.intp)
+        sub = self._hsub[sl]
+        new = np.empty((s_n, c, W), np.uint32)
+        for i, s in enumerate(slots):
+            new[i] = _packed_predicate(self._hx[s], self._hz[s],
+                                       self._hr[s], self._hact[s])
+        chg = new ^ self._mirror[sl]
+        chg[~sub] = 0
+        flat = chg.reshape(-1)
+        gidx = np.nonzero(flat)[0]
+        chg_vals = flat[gidx]
+        ent_vals = chg_vals & new.reshape(-1)[gidx]
+        self._mirror[sl] = new
+        epochs = [self._slot_epoch.get(s, 0) for s in slots]
+        if self.pipeline:
+            # pipelined cadence: events deliver one tick late, so the
+            # recovered tick parks as a synthetic inflight record
+            self._inflight = {"host": True, "slots": slots,
+                              "epochs": epochs,
+                              "payload": (chg_vals, ent_vals, gidx, s_n)}
+        else:
+            self._publish(slots, epochs, chg_vals, ent_vals, gidx, s_n)
+
+    def _flush_oracle(self) -> None:
+        """Level-2 fallback flush: the device is out of the loop entirely;
+        maintenance already reached the mirror when it was issued, so the
+        device queues just drain."""
+        self._pending_reset.clear()
+        self._pending_clear.clear()
+        if not self._staged:
+            if self._inflight is not None:
+                self._harvest()
+            return
+        slots = self._restage_shadows()
+        if self._seeded_unstaged:
+            raise RuntimeError(
+                "mesh AOI bucket: slots %r carry seeded interest state but "
+                "were not staged before flush -- stepping them would emit a "
+                "spurious mass-leave (stage the space first)"
+                % sorted(self._seeded_unstaged))
+        if self._inflight is not None:
+            self._harvest()  # deliver T-1 before parking T (cadence)
+        self._host_tick(slots)
+
+    def _apply_deferred_mirror_ops(self) -> None:
+        """Clears issued after a tick's dispatch apply now, AFTER its
+        stream; the epoch tag drops ops whose slot was released since (a
+        reacquired slot may carry freshly seeded set_prev words)."""
+        if self._mirror is None or not self._mirror_ops:
+            return
+        ops, self._mirror_ops = self._mirror_ops, []
+        for slot, ent, ep in ops:
+            if self._slot_epoch.get(slot, 0) == ep:
+                self._mirror_clear(slot, ent)
+
+    def _publish(self, slots, epochs, chg_vals, ent_vals, gidx,
+                 s_n: int) -> None:
+        """Expand a compact-layout classified stream into per-slot events
+        (host-recovery ticks; the device harvest keys by global slot)."""
+        pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx,
+                                           self.capacity, s_n)
+        ent_rows = _split_rows(pe)
+        lv_rows = _split_rows(pl)
+        empty = np.empty((0, 2), np.int32)
+        for row, (slot, epoch) in enumerate(zip(slots, epochs)):
+            if self._slot_epoch.get(slot, 0) != epoch:
+                continue  # released since the tick: events of a dead space
+            e = ent_rows.get(row, empty)
+            l = lv_rows.get(row, empty)
+            pend = self._events.get(slot)
+            if pend is not None:
+                e = np.concatenate([pend[0], e])
+                l = np.concatenate([pend[1], l])
+            self._events[slot] = (e, l)
+
     def _harvest(self, rec=None) -> None:  # gwlint: allow[host-sync] -- THE per-tick drain point: harvests kernel outputs once per flush
         if rec is None:
             rec, self._inflight = self._inflight, None
+        if rec.get("host"):
+            # synthetic record parked by fault recovery / oracle mode: the
+            # events were computed host-side at its tick; only the
+            # pipelined one-tick-late delivery remained
+            chg_vals, ent_vals, gidx, s_n = rec["payload"]
+            self._publish(rec["slots"], rec["epochs"], chg_vals, ent_vals,
+                          gidx, s_n)
+            self._apply_deferred_mirror_ops()
+            return
         c = self.capacity
         mc, kcap, mg, mx = rec["caps"]
         s_local = self.s_max // self.n_dev
@@ -659,11 +944,32 @@ class _MeshTPUBucket(_Bucket):
         (chg, g_vals, g_nv, g_lane, g_csel) = rec["scratch"]
         (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
          exc_new) = rec["streams"]
+        faults.check("aoi.fetch")  # stallable: a delayed host sync
         t0 = time.perf_counter()
+        poisoned = False
         if rec.get("all_unsub"):
             scal_h = np.zeros((self.n_dev, 5), np.int64)
         else:
-            scal_h = np.asarray(rec["scalars"])  # [n_dev, 5]
+            scal_h = faults.filter("aoi.scalars",
+                                   np.asarray(rec["scalars"]))  # [n_dev, 5]
+            nw = s_local * c * self.W  # words per chip
+            if not ((scal_h >= 0).all()
+                    and (scal_h[:, 0] <= chunk_base).all()
+                    and (scal_h[:, 1] <= _LANES).all()
+                    and (scal_h[:, 2] <= chunk_base).all()
+                    and (scal_h[:, 3] <= nw).all()
+                    and (scal_h[:, 4] <= nw).all()):
+                # garbage control scalars: distrust the encoded streams
+                # wholesale and recover every chip from its raw diff grid
+                # (without growing any caps off corrupted values)
+                from ..utils import gwlog
+
+                self.stats["poisoned"] += 1
+                gwlog.logger("gw.aoi").warning(
+                    "mesh AOI control scalars failed validation (%r); "
+                    "recovering the tick from the raw diff grids",
+                    scal_h.tolist())
+                poisoned = True
         self.perf["fetch_s"] += time.perf_counter() - t0
         pf = rec["prefetch"]
         all_c, all_e, all_g = [], [], []
@@ -671,6 +977,28 @@ class _MeshTPUBucket(_Bucket):
         peak = [0, 0, 0]  # per-chip maxima of (nd, n_esc, exc_n) this tick
         peak_mcc = 0
         for d in range(self.n_dev):
+            if poisoned:
+                t0 = time.perf_counter()
+                lo = d * s_local
+                chg_h = np.asarray(chg[lo:lo + s_local]).reshape(-1)
+                gidx = np.nonzero(chg_h)[0]
+                chg_vals = chg_h[gidx]
+                if self.pipeline and self._mirror is not None:
+                    # prev was donated to the NEXT dispatch already; the
+                    # pre-XOR mirror still holds this tick's old words, so
+                    # new = old ^ chg reconstructs the enter/leave split
+                    base = self._mirror[lo:lo + s_local].reshape(-1)[gidx]
+                    ent_vals = chg_vals & (base ^ chg_vals)
+                else:
+                    new_h = np.asarray(
+                        self.prev[lo:lo + s_local]).reshape(-1)
+                    ent_vals = chg_vals & new_h[gidx]
+                self.perf["fetch_s"] += time.perf_counter() - t0
+                all_c.append(chg_vals)
+                all_e.append(ent_vals)
+                all_g.append(np.asarray(gidx, np.int64)
+                             + d * chunk_base * _LANES)
+                continue
             nd, mcc, base_row, n_esc, exc_n = (int(v) for v in scal_h[d])
             if nd == 0 and exc_n == 0:
                 continue
@@ -737,7 +1065,7 @@ class _MeshTPUBucket(_Bucket):
             self._step_cache.clear()  # static caps changed
             self._scratch.clear()
             self._caps.reset_after_growth()
-        else:
+        elif not poisoned:  # poisoned peaks are zeros, not observations
             shrink = self._caps.observe(peak[0], peak_mcc,
                                         self._max_chunks, self._kcap)
             if shrink is not None:
@@ -774,14 +1102,9 @@ class _MeshTPUBucket(_Bucket):
                 if not keep.all():
                     gx, cv = gx[keep], cv[keep]
                 self._mirror.reshape(-1)[gx] ^= cv
-        if self._mirror is not None and self._mirror_ops:
-            # clears issued after this tick's dispatch apply now, AFTER its
-            # stream; the epoch tag drops ops whose slot was released since
-            # (a reacquired slot may carry freshly seeded set_prev words)
-            ops, self._mirror_ops = self._mirror_ops, []
-            for slot, e, ep in ops:
-                if self._slot_epoch.get(slot, 0) == ep:
-                    self._mirror_clear(slot, e)
+        # clears issued after this tick's dispatch apply now, AFTER its
+        # stream (see _apply_deferred_mirror_ops)
+        self._apply_deferred_mirror_ops()
         empty = np.empty((0, 2), np.int32)
         if all_c:
             pe, pl = EV.expand_classified_host(

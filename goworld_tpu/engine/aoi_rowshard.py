@@ -46,9 +46,10 @@ import time
 
 import numpy as np
 
+from .. import faults
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import _Bucket, _CapDecay
+from .aoi import _Bucket, _CapDecay, _device_fault, _packed_predicate
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -101,7 +102,21 @@ class _RowShardTPUBucket(_Bucket):
         self._dxr = self._dzr = None  # replicated [C]
         self._xz_stale = True
         self._delta_max_frac = 0.25
-        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0}
+        # fault tolerance (docs/robustness.md): NO standing mirror at this
+        # size -- the durable copies are the input shadows (prev equals
+        # their predicate except between set_prev and the next step, which
+        # _seed_prev covers under an active plan) plus _host_prev, the
+        # recovered state carried host-side while the device is down
+        self._ft = faults.active()
+        self._calc_level = 0  # 0 = platform default, 1 = dense, 2 = oracle
+        self._fault_phase = "stage"
+        self._seed_prev: np.ndarray | None = None
+        self._host_prev: np.ndarray | None = None
+        self._cur_old: tuple | None = None
+        self._tick_inflight = False  # restage done, events not yet harvested
+        self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
+                      "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
+                      "poisoned": 0, "calc_level": 0}
         self._pred = (512, 64, 256)
         self.full_roundtrips = 0
         self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
@@ -140,6 +155,7 @@ class _RowShardTPUBucket(_Bucket):
         if cached is not None and cached[0].shape == arr.shape and \
                 np.array_equal(cached[0], arr):
             return cached[1]
+        faults.check("aoi.h2d")
         dev = self._replicated(arr) if replicated else self.mesh.device_put(arr)
         self._h2d_cache[role] = (arr.copy(), dev)
         self.stats["h2d_bytes"] += arr.nbytes
@@ -197,6 +213,7 @@ class _RowShardTPUBucket(_Bucket):
                 and self._dxs is not None
                 and n_changed <= self._delta_max_frac * diff.size):
             if n_changed:
+                faults.check("aoi.delta")
                 cols = np.nonzero(diff)[0]
                 _, cols, xv, zv = AS.pad_packet(cols, cols, self._hx[cols],
                                                 self._hz[cols])
@@ -208,6 +225,7 @@ class _RowShardTPUBucket(_Bucket):
                     cols.nbytes + xv.nbytes + zv.nbytes
             self.stats["delta_flushes"] += 1
             return
+        faults.check("aoi.h2d")
         put = self.mesh.device_put
         self._dxs, self._dzs = put(self._hx), put(self._hz)
         self._dxr = self._replicated(self._hx)
@@ -218,11 +236,17 @@ class _RowShardTPUBucket(_Bucket):
 
     def _ensure_prev(self):
         if self.prev is None:
-            self.prev = self.mesh.device_put(
-                np.zeros((self.capacity, self.W), np.uint32))
+            faults.check("aoi.grow")  # the lazy state allocation seam
+            src = (self._host_prev if self._host_prev is not None
+                   else np.zeros((self.capacity, self.W), np.uint32))
+            self.prev = self.mesh.device_put(np.ascontiguousarray(src))
+            if self._host_prev is not None:  # rebuild after device loss
+                self.stats["h2d_bytes"] += src.nbytes
+                self._host_prev = None
 
     def _sharded_step(self):
-        key = (self._max_chunks, self._kcap, self._max_gaps, self._max_exc)
+        key = (self._max_chunks, self._kcap, self._max_gaps, self._max_exc,
+               self._calc_level)
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
@@ -234,7 +258,8 @@ class _RowShardTPUBucket(_Bucket):
 
         from ..ops.aoi_dense import aoi_step_chg
 
-        platform = self.mesh.platform
+        # calculator fallback chain level 1: force the fused dense path
+        platform = "cpu" if self._calc_level >= 1 else self.mesh.platform
         mc, kcap = self._max_chunks, self._kcap
         mg, mx = self._max_gaps, self._max_exc
         cl = self.c_local
@@ -336,6 +361,14 @@ class _RowShardTPUBucket(_Bucket):
 
     def _apply_maintenance(self) -> None:
         if not self._pending_clear or self.prev is None:
+            if self._pending_clear and self._host_prev is not None:
+                # device down after a recovery: the maintenance scatter
+                # lands on the host copy _ensure_prev will re-upload
+                for ent in set(self._pending_clear):
+                    self._host_prev[ent] = 0
+                    w, b = P.word_bit_for_column(ent, self.capacity)
+                    self._host_prev[:, w] &= np.uint32(
+                        ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
             self._pending_clear.clear()
             return
         import jax.numpy as jnp
@@ -386,27 +419,48 @@ class _RowShardTPUBucket(_Bucket):
         )
         return key, sc
 
-    def flush(self) -> None:  # gwlint: allow[host-sync] -- flush epilogue hands results to the harvest drain
-        self._apply_maintenance()
-        if not self._staged:
+    def flush(self) -> None:
+        if self._calc_level >= 2:
+            # calculator fallback chain bottom: host-oracle mode
+            self._flush_oracle()
             return
-        t0 = time.perf_counter()
+        try:
+            self._flush_device()
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self._recover(e)
+
+    def _restage_shadows(self) -> None:
+        """Pop the staged tick into the persistent shadows, keeping the
+        pre-tick values in _cur_old (the _stage_xz diff base, and the
+        durable old state for fault recovery)."""
         (sx, sz, sr, sa) = self._staged.pop(0)
         n = len(sx)
-        # save the previous staged values (one [C] copy each) so _stage_xz
-        # can diff the new tick against them
-        old_x, old_z = self._hx.copy(), self._hz.copy()
-        old_r, old_act = self._hr.copy(), self._hact.copy()
+        self._cur_old = (self._hx.copy(), self._hz.copy(),
+                         self._hr.copy(), self._hact.copy())
         self._hx[:n] = sx
         self._hz[:n] = sz
         self._hr[:n] = sr
         self._hact[:] = False
         self._hact[:n] = sa
         self._staged.clear()
+
+    def _flush_device(self) -> None:  # gwlint: allow[host-sync] -- flush epilogue hands results to the harvest drain
+        self._fault_phase = "stage"
+        self._apply_maintenance()
+        if not self._staged:
+            return
+        t0 = time.perf_counter()
+        self._restage_shadows()
+        self._tick_inflight = True  # a restaged tick awaits its events
+        old_x, old_z, old_r, old_act = self._cur_old
         self._ensure_prev()
         key, scratch = self._get_scratch()
         self._stage_xz(old_x, old_z, old_r, old_act)
         sub = self._h2d("sub", np.asarray(self._subscribed), replicated=True)
+        self._fault_phase = "kernel"
+        faults.check("aoi.kernel")
         out = self._sharded_step()(
             self.prev, *scratch,
             self._dxs, self._dzs,
@@ -450,6 +504,10 @@ class _RowShardTPUBucket(_Bucket):
              "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
                          exc_new),
              "scalars": scalars, "prefetch": pf})
+        # the tick delivered: prev == predicate(shadows) again, so a
+        # set_prev seed is no longer the recovery base
+        self._seed_prev = None
+        self._tick_inflight = False
 
     def _harvest(self, rec) -> None:  # gwlint: allow[host-sync] -- THE per-tick drain point: harvests kernel outputs once per flush
         c = self.capacity
@@ -459,8 +517,30 @@ class _RowShardTPUBucket(_Bucket):
         (chg, g_vals, g_nv, g_lane, g_csel) = rec["scratch"]
         (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
          exc_new) = rec["streams"]
+        faults.check("aoi.fetch")  # stallable: a delayed host sync
         t0 = time.perf_counter()
-        scal_h = np.asarray(rec["scalars"])  # [n_dev, 5]
+        scal_h = faults.filter("aoi.scalars",
+                               np.asarray(rec["scalars"]))  # [n_dev, 5]
+        poisoned = False
+        nw = cl * self.W  # words per chip
+        if not ((scal_h >= 0).all()
+                and (scal_h[:, 0] <= chunk_base).all()
+                and (scal_h[:, 1] <= _LANES).all()
+                and (scal_h[:, 2] <= chunk_base).all()
+                and (scal_h[:, 3] <= nw).all()
+                and (scal_h[:, 4] <= nw).all()):
+            # garbage control scalars: distrust the encoded streams and
+            # recover every chip from its raw diff grid (no cap growth off
+            # corrupted values).  The flush is synchronous, so self.prev
+            # still holds THIS tick's new words
+            from ..utils import gwlog
+
+            self.stats["poisoned"] += 1
+            gwlog.logger("gw.aoi").warning(
+                "row-shard AOI control scalars failed validation (%r); "
+                "recovering the tick from the raw diff grids",
+                scal_h.tolist())
+            poisoned = True
         self.perf["fetch_s"] += time.perf_counter() - t0
         pf = rec["prefetch"]
         all_c, all_e, all_g = [], [], []
@@ -468,6 +548,20 @@ class _RowShardTPUBucket(_Bucket):
         peak = [0, 0, 0]
         peak_mcc = 0
         for d in range(self.n_dev):
+            if poisoned:
+                t0 = time.perf_counter()
+                lo = d * cl
+                chg_h = np.asarray(chg[lo:lo + cl]).reshape(-1)
+                gidx = np.nonzero(chg_h)[0]
+                chg_vals = chg_h[gidx]
+                new_h = np.asarray(self.prev[lo:lo + cl]).reshape(-1)
+                ent_vals = chg_vals & new_h[gidx]
+                self.perf["fetch_s"] += time.perf_counter() - t0
+                all_c.append(chg_vals)
+                all_e.append(ent_vals)
+                all_g.append(np.asarray(gidx, np.int64)
+                             + d * chunk_base * _LANES)
+                continue
             nd, mcc, base_row, n_esc, exc_n = (int(v) for v in scal_h[d])
             if nd == 0 and exc_n == 0:
                 continue
@@ -528,7 +622,7 @@ class _RowShardTPUBucket(_Bucket):
             self._step_cache.clear()
             self._scratch.clear()
             self._caps.reset_after_growth()
-        else:
+        elif not poisoned:  # poisoned peaks are zeros, not observations
             shrink = self._caps.observe(peak[0], peak_mcc,
                                         self._max_chunks, self._kcap)
             if shrink is not None:
@@ -559,19 +653,160 @@ class _RowShardTPUBucket(_Bucket):
             self._scratch.setdefault(rec["key"], rec["scratch"])
         self.perf["decode_s"] += time.perf_counter() - t0
 
+    # -- fault recovery (docs/robustness.md): no standing mirror at this
+    # size, so the durable old state is reconstructed on demand -- the
+    # set_prev seed if one is live (kept under an active plan), else the
+    # predicate of the pre-tick shadows (exact: prev always equals the
+    # predicate of the last stepped inputs, and clear_entity keeps the
+    # shadows consistent).  The recovered tick publishes same-tick (this
+    # bucket's flush is synchronous) and _host_prev carries the state until
+    # _ensure_prev re-uploads it.
+
+    def reset_calc_chain(self) -> None:
+        """Re-arm the device calculator after fallback (operator action --
+        demotion is sticky so a flapping device cannot oscillate)."""
+        self._calc_level = 0
+        self.stats["calc_level"] = 0
+        # prev rebuilds lazily from _host_prev at the next _ensure_prev
+
+    def _old_prev_host(self) -> np.ndarray:
+        """The pre-tick interest words, reconstructed host-side."""
+        if self._seed_prev is not None:
+            old = self._seed_prev.copy()
+        elif self._cur_old is not None:
+            ox, oz, orr, oact = self._cur_old
+            old = _packed_predicate(ox, oz, orr, oact)
+        else:
+            old = np.zeros((self.capacity, self.W), np.uint32)
+        # land any clears still queued for the device (idempotent: the
+        # predicate of shadows already excludes cleared entities)
+        for ent in set(self._pending_clear):
+            old[ent] = 0
+            w, b = P.word_bit_for_column(ent, self.capacity)
+            old[:, w] &= np.uint32(~(np.uint32(1) << np.uint32(b))
+                                   & 0xFFFFFFFF)
+        self._pending_clear.clear()
+        return old
+
+    def _recover(self, e: BaseException) -> None:
+        """Device fault mid-flush: recompute the faulted tick host-side
+        (bit-exact) and drop all device state."""
+        from ..utils import gwlog
+
+        self.stats["rebuilds"] += 1
+        if self._fault_phase == "kernel" and self._calc_level < 2:
+            self._calc_level += 1
+            self.stats["fallbacks"] += 1
+            self.stats["calc_level"] = self._calc_level
+        gwlog.logger("gw.aoi").warning(
+            "row-shard AOI bucket (cap %d) device fault during %s: %s -- "
+            "recovering tick on host (calc level %d)",
+            self.capacity, self._fault_phase, e, self._calc_level)
+        # _flush_device restages BEFORE the device seams, so at fault time
+        # the tick may already live in the shadows (_tick_inflight) rather
+        # than in _staged -- both mean "a tick's events must be recovered"
+        inflight = self._tick_inflight
+        staged = inflight or bool(self._staged)
+        if staged:
+            if not inflight:
+                self._restage_shadows()
+            old_prev = self._old_prev_host()
+        else:
+            # maintenance-only flush: nothing stepped, so there are no
+            # events to recover -- only the state survives.  The current
+            # shadows ARE the last stepped inputs; _old_prev_host derives
+            # the pre-fault words from them (or the set_prev seed) and
+            # lands any queued clears
+            self._cur_old = (self._hx, self._hz, self._hr, self._hact)
+            old_prev = self._old_prev_host()
+        # drop device state; _ensure_prev re-uploads _host_prev next flush
+        self.prev = None
+        self._dxs = self._dzs = self._dxr = self._dzr = None
+        self._xz_stale = True
+        self._h2d_cache.clear()
+        self._scratch.clear()
+        if staged:
+            self._host_tick(old_prev)
+        else:
+            self._host_prev = old_prev
+            self._seed_prev = None
+            self._cur_old = None
+        self._tick_inflight = False
+
+    def _host_tick(self, old_prev: np.ndarray) -> None:
+        """One tick on the host from the durable copies, bit-exact with the
+        sharded step: the global flat word order equals the per-chip
+        extraction order after the chip-offset shift."""
+        self.stats["host_ticks"] += 1
+        new = _packed_predicate(self._hx, self._hz, self._hr, self._hact)
+        empty = np.empty((0, 2), np.int32)
+        if self._subscribed:
+            chg = new ^ old_prev
+            flat = chg.reshape(-1)
+            gidx = np.nonzero(flat)[0]
+            chg_vals = flat[gidx]
+            ent_vals = chg_vals & new.reshape(-1)[gidx]
+            pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx,
+                                               self.capacity, 1)
+            e = pe[:, 1:] if len(pe) else empty
+            l = pl[:, 1:] if len(pl) else empty
+        else:
+            e = l = empty
+        pend = self._events.get(0)
+        if pend is not None:
+            e = np.concatenate([pend[0], e])
+            l = np.concatenate([pend[1], l])
+        self._events[0] = (e, l)
+        self._host_prev = new
+        self._seed_prev = None
+        self._cur_old = None
+
+    def _flush_oracle(self) -> None:
+        """Level-2 fallback flush: the device is out of the loop entirely;
+        _host_prev is the authoritative state."""
+        if self._host_prev is None:
+            self._host_prev = np.zeros((self.capacity, self.W), np.uint32)
+        if self._pending_clear:
+            # the device maintenance scatter, applied to the host copy
+            for ent in set(self._pending_clear):
+                self._host_prev[ent] = 0
+                w, b = P.word_bit_for_column(ent, self.capacity)
+                self._host_prev[:, w] &= np.uint32(
+                    ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
+            self._pending_clear.clear()
+        if not self._staged:
+            return
+        self._restage_shadows()
+        old_prev = self._seed_prev if self._seed_prev is not None \
+            else self._host_prev
+        self._host_tick(old_prev)
+
     # -- state carry / lazy derivation --------------------------------------
     def get_prev(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path
         self.flush()
         if self.prev is None:
+            if self._host_prev is not None:  # device down: host copy rules
+                return np.array(self._host_prev, copy=True)
             return np.zeros((self.capacity, self.W), np.uint32)
         self.full_roundtrips += 1
         return np.asarray(self.prev)
 
     def set_prev(self, slot: int, words: np.ndarray) -> None:
         self.flush()
+        words = np.ascontiguousarray(words, np.uint32)
+        if self._calc_level >= 2 or self.prev is None:
+            # device down: the words land host-side; _ensure_prev uploads
+            # them if the calculator chain re-arms
+            self._host_prev = words.copy()
+            self._seed_prev = None
+            return
         self.full_roundtrips += 1
-        self.prev = self.mesh.device_put(
-            np.ascontiguousarray(words, np.uint32))
+        if self._ft:
+            # the seed is the ONLY durable copy of carried-in state until
+            # the next step (prev != predicate(shadows) in between); keep
+            # it host-side while a fault plan is active
+            self._seed_prev = words.copy()
+        self.prev = self.mesh.device_put(words)
 
     def peek_words(self, slot: int):
         return None  # no host mirror at this size; use derive_row/derive_col
@@ -580,6 +815,8 @@ class _RowShardTPUBucket(_Bucket):
         """One observer's interest words [W] -- a 16 KB on-demand fetch."""
         self.flush()
         if self.prev is None:
+            if self._host_prev is not None:  # device down: host copy rules
+                return np.array(self._host_prev[entity_slot], copy=True)
             return np.zeros(self.W, np.uint32)
         return np.asarray(self.prev[entity_slot])
 
@@ -587,8 +824,11 @@ class _RowShardTPUBucket(_Bucket):
         """Row indices of observers interested in ``entity_slot`` (the
         packed column), from one [C] word-column fetch."""
         self.flush()
-        if self.prev is None:
-            return np.empty(0, np.int64)
         w, b = P.word_bit_for_column(entity_slot, self.capacity)
-        colw = np.asarray(self.prev[:, w])
+        if self.prev is None:
+            if self._host_prev is None:
+                return np.empty(0, np.int64)
+            colw = self._host_prev[:, w]  # device down: host copy rules
+        else:
+            colw = np.asarray(self.prev[:, w])
         return np.nonzero(colw & (np.uint32(1) << np.uint32(b)))[0]

@@ -22,6 +22,7 @@ from __future__ import annotations
 import time
 from typing import Callable
 
+from .. import faults
 from ..utils.crontab import Crontab
 from .aoi import AOIEngine
 from .entity import SYNC_NEIGHBORS, SYNC_OWN, Entity
@@ -41,7 +42,12 @@ class Runtime:
         aoi_delta_staging: bool = True,
         aoi_tpu_min_capacity: int = 4096,
         aoi_rowshard_min_capacity: int = 65536,
+        fault_plan: "faults.FaultPlan | str | None" = None,
     ):
+        # Install BEFORE AOIEngine construction: buckets decide at __init__
+        # whether to keep eager host mirrors (faults.active()).
+        if fault_plan is not None:
+            faults.install(fault_plan)
         self.now = now
         self.on_error = on_error or self._default_on_error
         self.timers = TimerQueue(now)

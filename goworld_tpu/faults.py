@@ -1,0 +1,278 @@
+"""Deterministic fault injection: every failure we can name is replayable.
+
+The round-5 verdict's headline failure was robustness, not perf: one TPU
+``RESOURCE_EXHAUSTED`` inside one bench config voided the whole artifact,
+and the device-resident AOI buckets hold the only live copy of tick state
+on-chip.  GoWorld's reference design treats failure as routine (freeze/
+restore, dispatcher reconnect, heartbeat kicks); this module gives
+goworld_tpu the injection half of that story -- the recovery half lives in
+the engine buckets (rebuild-from-shadow, calculator fallback chain; see
+docs/robustness.md) and in dispatchercluster (backoff + replay).
+
+A :class:`FaultPlan` is a seedable list of (seam, kind, occurrence) specs.
+Production code is instrumented with named *seams* -- ``faults.check(seam)``
+calls that are no-ops (one global load + ``is None`` test) until a plan is
+installed.  Each seam keeps an occurrence counter; a spec fires when its
+seam's counter hits the spec's ``at`` (1-based), so a given (seed, seam,
+occurrence) tuple replays the same fault in every run -- tests and CI can
+assert on exact fault ticks.
+
+Seam catalog (every name here must be exercised by at least one test --
+enforced by the ``fault-seam-coverage`` gwlint rule):
+
+========================  =====================================================
+seam                      fires in
+========================  =====================================================
+``aoi.grow``              device allocation when a bucket grows its slots
+``aoi.h2d``               full role-array upload (``_h2d``) during staging
+``aoi.delta``             sparse delta-packet scatter during staging
+``aoi.kernel``            the fused AOI kernel launch (bucket step)
+``aoi.scalars``           control-scalar fetch (poison: corrupt the values)
+``aoi.fetch``             event-stream harvest (stall: delay the host sync)
+``conn.send``             typed packet send (proto/connection.py)
+``conn.flush``            framed batch write (netutil/conn.py flush)
+``conn.recv``             blocking packet read (netutil/conn.py recv)
+``disp.connect``          dispatcher connect attempt (dispatchercluster)
+``bench.config``          per-config bench run (bench.py main loop)
+========================  =====================================================
+
+Kinds: ``oom`` (raise :class:`DeviceOOM`), ``fail`` (raise
+:class:`KernelFailure`), ``reset`` (raise ``ConnectionResetError``),
+``stall`` (sleep ``arg`` seconds, then continue), ``partial`` (returned to
+the caller, which writes ``arg`` fraction of the bytes then drops the
+link), ``poison`` (applied via :func:`filter`: corrupt the value).
+
+Activation: ``faults.install(plan)`` (what ``Runtime(fault_plan=...)``
+does), or the ``GW_FAULT_PLAN`` environment variable, parsed at import::
+
+    GW_FAULT_PLAN="seed=7;aoi.h2d:oom@3;aoi.kernel:fail@5;conn.flush:reset@2"
+
+Entry grammar: ``seam:kind@AT[xCOUNT][:ARG]`` -- fire ``kind`` at the
+``AT``-th occurrence of ``seam`` (``COUNT`` consecutive occurrences,
+default 1), with optional float ``ARG`` (stall seconds / partial
+fraction).  ``AT`` may be ``auto``: derived deterministically from the
+plan seed and the seam name, so a seeded plan scatters faults without
+hand-picking ticks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+KINDS = ("oom", "fail", "stall", "poison", "reset", "partial")
+
+SEAMS = {
+    "aoi.grow": "device allocation on bucket slot growth",
+    "aoi.h2d": "full role-array upload during input staging",
+    "aoi.delta": "sparse delta-packet scatter during input staging",
+    "aoi.kernel": "fused AOI kernel launch",
+    "aoi.scalars": "control-scalar fetch (poisonable)",
+    "aoi.fetch": "event-stream harvest host sync (stallable)",
+    "conn.send": "typed packet send",
+    "conn.flush": "framed batch write",
+    "conn.recv": "blocking packet read",
+    "disp.connect": "dispatcher connect attempt",
+    "bench.config": "per-config bench run",
+}
+
+
+class InjectedFault(RuntimeError):
+    """Base class for all injected faults (so recovery code can tell an
+    injected fault from a logic bug when it matters)."""
+
+
+class DeviceOOM(InjectedFault):
+    """Injected device allocation failure.  The message mimics the real
+    jaxlib error text so log-greps and classifiers treat both alike."""
+
+    def __init__(self, seam: str, occurrence: int):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected device OOM "
+            f"(seam={seam}, occurrence={occurrence})")
+
+
+class KernelFailure(InjectedFault):
+    """Injected kernel-launch failure."""
+
+    def __init__(self, seam: str, occurrence: int):
+        super().__init__(
+            f"INTERNAL: injected kernel failure "
+            f"(seam={seam}, occurrence={occurrence})")
+
+
+@dataclass
+class FaultSpec:
+    seam: str
+    kind: str
+    at: int          # 1-based occurrence at which to start firing
+    count: int = 1   # consecutive occurrences to fire on
+    arg: float | None = None  # stall seconds / partial fraction
+
+    def __post_init__(self):
+        if self.seam not in SEAMS:
+            raise ValueError(f"unknown fault seam {self.seam!r}")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.at < 1 or self.count < 1:
+            raise ValueError("fault occurrence/count must be >= 1")
+
+    def matches(self, occurrence: int) -> bool:
+        return self.at <= occurrence < self.at + self.count
+
+
+def derive_occurrence(seed: int, seam: str, lo: int = 1, hi: int = 8) -> int:
+    """Deterministic occurrence in [lo, hi] from (seed, seam) -- the
+    ``@auto`` scheduling.  sha256, not ``random``: stable across processes
+    and python versions."""
+    h = hashlib.sha256(f"{seed}:{seam}".encode()).digest()
+    return lo + int.from_bytes(h[:4], "little") % (hi - lo + 1)
+
+
+class FaultPlan:
+    """A seedable, thread-safe set of fault specs with per-seam occurrence
+    counters.  ``fired`` records every fault taken (seam, kind, occurrence,
+    arg) for tests and status reporting."""
+
+    def __init__(self, seed: int = 0, specs: list[FaultSpec] | None = None):
+        self.seed = seed
+        self.specs: list[FaultSpec] = list(specs or [])
+        self.counts: dict[str, int] = {}
+        self.fired: list[dict] = []
+        self._lock = threading.Lock()
+
+    def add(self, seam: str, kind: str, at: int | str = "auto",
+            count: int = 1, arg: float | None = None) -> "FaultPlan":
+        if at == "auto":
+            at = derive_occurrence(self.seed, seam)
+        self.specs.append(FaultSpec(seam, kind, int(at), count, arg))
+        return self
+
+    # -- firing ------------------------------------------------------------
+    def _hit(self, seam: str) -> tuple[FaultSpec | None, int]:
+        with self._lock:
+            n = self.counts.get(seam, 0) + 1
+            self.counts[seam] = n
+            for spec in self.specs:
+                if spec.seam == seam and spec.matches(n):
+                    self.fired.append({"seam": seam, "kind": spec.kind,
+                                       "occurrence": n, "arg": spec.arg})
+                    return spec, n
+        return None, n
+
+    def check(self, seam: str) -> FaultSpec | None:
+        """Count one occurrence of ``seam``; raise/stall if a spec fires.
+        Returns the fired spec for caller-handled kinds (``partial``),
+        None otherwise."""
+        spec, n = self._hit(seam)
+        if spec is None:
+            return None
+        if spec.kind == "oom":
+            raise DeviceOOM(seam, n)
+        if spec.kind == "fail":
+            raise KernelFailure(seam, n)
+        if spec.kind == "reset":
+            raise ConnectionResetError(
+                f"injected connection reset (seam={seam}, occurrence={n})")
+        if spec.kind == "stall":
+            time.sleep(spec.arg if spec.arg is not None else 0.005)
+            return spec
+        return spec  # partial / poison: the caller applies it
+
+    def filter(self, seam: str, value):
+        """Count one occurrence of ``seam``; when a ``poison`` spec fires,
+        return a corrupted copy of ``value`` (numpy arrays get garbage the
+        consumer's validation must catch), else ``value`` unchanged."""
+        spec, _ = self._hit(seam)
+        if spec is None or spec.kind != "poison":
+            return value
+        import numpy as np
+
+        arr = np.array(value, copy=True)
+        if arr.dtype.kind == "f":
+            arr[...] = np.nan
+        else:
+            # most-negative value of the dtype: fails any sane range check
+            arr[...] = np.iinfo(arr.dtype).min
+        return arr
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"seed": self.seed, "counts": dict(self.counts),
+                    "fired": list(self.fired),
+                    "specs": [vars(s).copy() for s in self.specs]}
+
+
+def parse(text: str) -> FaultPlan:
+    """Parse a ``GW_FAULT_PLAN`` string (grammar in the module docstring)."""
+    seed = 0
+    entries = []
+    for part in filter(None, (p.strip() for p in text.split(";"))):
+        if part.startswith("seed="):
+            seed = int(part[5:])
+        else:
+            entries.append(part)
+    plan = FaultPlan(seed)
+    for part in entries:
+        seam, _, rest = part.partition(":")
+        kind, _, where = rest.partition("@")
+        if not where:
+            raise ValueError(f"bad fault spec {part!r} (want seam:kind@at)")
+        arg = None
+        if ":" in where:
+            where, _, argtext = where.partition(":")
+            arg = float(argtext)
+        count = 1
+        if "x" in where:
+            where, _, counttext = where.partition("x")
+            count = int(counttext)
+        at = "auto" if where == "auto" else int(where)
+        plan.add(seam, kind, at, count, arg)
+    return plan
+
+
+# -- process-global plan ---------------------------------------------------
+_PLAN: FaultPlan | None = None
+
+
+def install(plan: "FaultPlan | str | None") -> FaultPlan | None:
+    """Install a plan process-wide (str specs are parsed); None clears."""
+    global _PLAN
+    _PLAN = parse(plan) if isinstance(plan, str) else plan
+    return _PLAN
+
+
+def clear() -> None:
+    install(None)
+
+
+def plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def check(seam: str) -> FaultSpec | None:
+    """The seam hook.  No plan installed: one global load, zero cost."""
+    p = _PLAN
+    if p is None:
+        return None
+    return p.check(seam)
+
+
+def filter(seam: str, value):  # noqa: A001 -- deliberate: faults.filter(seam, v)
+    p = _PLAN
+    if p is None:
+        return value
+    return p.filter(seam, value)
+
+
+_env = os.environ.get("GW_FAULT_PLAN")
+if _env:
+    _PLAN = parse(_env)
+del _env

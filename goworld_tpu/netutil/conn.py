@@ -19,6 +19,7 @@ import socket
 import struct
 import threading
 
+from .. import faults
 from ..utils import opmon
 from .compress import Compressor, new_compressor
 from .packet import MAX_PACKET_SIZE, Packet
@@ -89,11 +90,38 @@ class PacketConnection:
         with self._send_lock:
             self._pending.append(payload)
 
+    def take_pending(self) -> list[bytes]:
+        """Pop and return the un-flushed payloads (for reconnect salvage:
+        a dead connection's pending sends can be replayed on its
+        replacement via ``send_raw``)."""
+        with self._send_lock:
+            batch, self._pending = self._pending, []
+        return batch
+
+    def send_raw(self, payload: bytes):
+        """Queue an already-extracted payload (reconnect replay path)."""
+        with self._send_lock:
+            self._pending.append(payload)
+
     def flush(self) -> int:
         """Frame and write everything pending in one syscall; returns bytes
         written.  (Reference: single-flusher Flush(reason),
         PacketConnection.go:98-163.)"""
         with self._flush_lock:
+            # A closed connection must not pop the batch: sends that raced
+            # the close stay in _pending for reconnect salvage instead of
+            # being dropped into a doomed sendall.  Checked before the
+            # fault seam so dead-link flushes don't consume occurrences.
+            if self.closed:
+                raise ConnectionResetError("flush on closed connection")
+            # The seam fires BEFORE the batch is popped: an injected reset
+            # leaves _pending intact, so reconnect salvage sees the full
+            # batch and replay stays exactly-once.
+            try:
+                spec = faults.check("conn.flush")
+            except ConnectionResetError:
+                self.close()  # peer sees EOF, like a real dropped link
+                raise
             with self._send_lock:
                 batch, self._pending = self._pending, []
             if not batch:
@@ -117,9 +145,18 @@ class PacketConnection:
             if timeout is not None:
                 self._sock.settimeout(None)
             try:
+                if spec is not None and spec.kind == "partial":
+                    # Write a prefix of the batch, then drop the link: the
+                    # peer's FrameParser is left mid-frame, exactly like a
+                    # connection cut between TCP segments.
+                    frac = spec.arg if spec.arg is not None else 0.5
+                    self._sock.sendall(bytes(out[: int(len(out) * frac)]))
+                    self.close()
+                    raise ConnectionResetError(
+                        "injected partial write (link dropped mid-frame)")
                 self._sock.sendall(out)
             finally:
-                if timeout is not None:
+                if timeout is not None and not self.closed:
                     self._sock.settimeout(timeout)
                 op.finish()
             return len(out)
@@ -128,6 +165,11 @@ class PacketConnection:
     def recv_packet(self, bufsize: int = 65536) -> Packet | None:
         """Blocking read of the next packet; None on clean EOF."""
         while not self._recv_chunks:
+            try:
+                faults.check("conn.recv")
+            except ConnectionResetError:
+                self.close()
+                raise
             data = self._sock.recv(bufsize)
             if not data:
                 return None

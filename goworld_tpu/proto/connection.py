@@ -11,6 +11,7 @@ from __future__ import annotations
 import threading
 import time
 
+from .. import faults
 from ..netutil import Packet, PacketConnection
 from . import msgtypes as MT
 
@@ -25,6 +26,11 @@ class GWConnection:
 
     # -- plumbing ----------------------------------------------------------
     def send(self, p: Packet):
+        try:
+            faults.check("conn.send")
+        except ConnectionResetError:
+            self.pc.close()
+            raise
         self.pc.send_packet(p)
 
     def flush(self):

@@ -24,7 +24,13 @@ python -m goworld_tpu.analysis goworld_tpu/ || fail=1
 echo "== delta smoke =="
 JAX_PLATFORMS=cpu python scripts/delta_smoke.py || fail=1
 
-# 4. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 4. fault-injection smoke (CPU backend: device OOM + kernel failure +
+#    poisoned scalars injected mid-walk; events stay bit-exact vs the
+#    uninjected oracle -- docs/robustness.md)
+echo "== faults smoke =="
+JAX_PLATFORMS=cpu python scripts/faults_smoke.py || fail=1
+
+# 5. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

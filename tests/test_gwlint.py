@@ -17,7 +17,7 @@ import textwrap
 from pathlib import Path
 
 from goworld_tpu.analysis import coverage, determinism, dtypes, \
-    h2d_staging, host_sync, wire_protocol
+    fault_seams, h2d_staging, host_sync, wire_protocol
 from goworld_tpu.analysis.__main__ import main as gwlint_main
 from goworld_tpu.analysis.core import run
 
@@ -333,6 +333,113 @@ def test_h2d_staging_out_of_scope_files_untouched(tmp_path):
     _mk(tmp_path, {"ops/stage_helper.py": STAGE})
     findings, _ = _run(tmp_path, [h2d_staging.check])
     assert findings == []
+
+
+STAGE_HELPER = """\
+    import jax.numpy as jnp
+
+    class Bucket:
+        def flush(self):
+            return self._flush_device()
+
+        def _flush_device(self):
+            dx = jnp.asarray(self._hx)
+            return dx
+
+        def _stage_inputs(self):
+            return jnp.asarray(self._hz)
+"""
+
+
+def test_h2d_staging_covers_flush_helpers(tmp_path):
+    """The fault-tolerance refactor moved flush bodies into _flush_device;
+    a shadow upload there is the same contract violation."""
+    _mk(tmp_path, {"engine/aoi_mesh.py": STAGE_HELPER})
+    findings, _ = _run(tmp_path, [h2d_staging.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("engine/aoi_mesh.py", _ln(STAGE_HELPER, "jnp.asarray(self._hx)")),
+    }
+    # _stage_inputs is the seam itself: never flagged
+
+
+# -- fault-seam-coverage -----------------------------------------------------
+
+FAULTS_CATALOG = """\
+    SEAMS = {
+        "aoi.kernel": "kernel launch",
+        "conn.reset2": "untested seam",
+        "dead.seam": "checked nowhere",
+    }
+"""
+
+FAULTS_USER = """\
+    from . import faults
+
+    def flush():
+        faults.check("aoi.kernel")
+        faults.check("conn.reset2")
+        faults.check("not.declared")
+"""
+
+
+def test_fault_seam_coverage_flags_all_three_rots(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py": FAULTS_CATALOG,
+        "goworld_tpu/engine.py": FAULTS_USER,
+        "tests/test_f.py":
+            "def test_kernel():\n"
+            "    assert 'aoi.kernel'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    by_msg = sorted((f.path, f.line, f.message) for f in findings)
+    # dead.seam draws BOTH untested and dead-entry findings: 4 total
+    assert len(by_msg) == 4, by_msg
+    # used-but-undeclared, at the call site
+    assert by_msg[0][0] == "goworld_tpu/engine.py"
+    assert by_msg[0][1] == _ln(FAULTS_USER, '"not.declared"')
+    assert "'not.declared'" in by_msg[0][2]
+    # declared-but-untested + declared-but-unused, at the declarations
+    msgs = [m for p, _ln_, m in by_msg if p == "goworld_tpu/faults.py"]
+    assert sum("never referenced from tests/" in m for m in msgs) == 2
+    assert sum("dead catalog entry" in m for m in msgs) == 1
+    assert any("'conn.reset2'" in m for m in msgs)
+    assert any("'dead.seam'" in m for m in msgs)
+    # 'aoi.kernel' -- declared, checked, tested -- is clean
+    assert not any("'aoi.kernel'" in m for _p, _l, m in by_msg)
+
+
+def test_fault_seam_coverage_clean_catalog(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py":
+            'SEAMS = {"aoi.kernel": "kernel launch"}\n',
+        "goworld_tpu/engine.py":
+            "from . import faults\n"
+            "def flush():\n"
+            '    faults.check("aoi.kernel")\n',
+        "tests/test_f.py": "assert 'aoi.kernel'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    assert findings == []
+
+
+def test_fault_seam_coverage_sees_root_scripts(tmp_path):
+    """A seam whose only production user is a repo-root script (bench.py)
+    is not a dead catalog entry -- but it still must be tested."""
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py":
+            'SEAMS = {"bench.config": "per-config run"}\n',
+        "bench.py":
+            "from goworld_tpu import faults\n"
+            'faults.check("bench.config")\n',
+        "tests/test_f.py": "assert 'bench.config'\n",
+    })
+    findings, _ = run([str(tmp_path / "goworld_tpu")], root=str(tmp_path),
+                      checkers=[fault_seams.check],
+                      tests_dir=str(tmp_path / "tests"))
+    assert findings == [], [f.render() for f in findings]
 
 
 # -- the real tree -----------------------------------------------------------
