@@ -1304,11 +1304,23 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
 
     perf0 = perf_snapshot()
     stats0 = stats_snapshot()
+    # unified telemetry over the measured window only: spans give the
+    # per-phase breakdown (stage/kernel/diff/fetch/emit) straight from the
+    # tracer ring, cross-checkable against the bucket perf counters above
+    from goworld_tpu import telemetry
+    from goworld_tpu.telemetry import trace as gwtrace
+
+    telemetry.enable()
+    gwtrace.reset()
     dt = float("inf")
     for _rep in range(reps):
         t0 = time.perf_counter()
         run_ticks(0, ticks, measure=True)
         dt = min(dt, time.perf_counter() - t0)
+    span_s: dict[str, float] = {}
+    for _name, _tid, _s0, _s1 in gwtrace.spans():
+        span_s[_name] = span_s.get(_name, 0.0) + (_s1 - _s0)
+    telemetry.disable()
     kind = backend + ("+pipeline" if pipeline else "")
     drive = "bulk move_entities" if bulk else "per-entity set_position"
     if movers_frac is not None:
@@ -1359,6 +1371,19 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
                 d / total_ticks * 1e3, 2)
             other -= d
         out["host_other_ms"] = round(other / total_ticks * 1e3, 2)
+    # span-derived phase breakdown (telemetry tracer, measured window only):
+    # the same taxonomy /debug/trace exports, averaged per tick.  "emit" has
+    # no perf-counter twin -- event replay through entity hooks is only
+    # visible as a span -- which is the reason this rides the tracer
+    out["phase_ms"] = {
+        ph: round(span_s.get(nm, 0.0) / total_ticks * 1e3, 3)
+        for ph, nm in (("stage", "aoi.stage"), ("kernel", "aoi.kernel"),
+                       ("diff", "aoi.diff"), ("fetch", "aoi.fetch"),
+                       ("emit", "aoi.emit"))
+    }
+    if span_s.get("tick"):
+        out["span_tick_ms"] = round(
+            span_s["tick"] / total_ticks * 1e3, 2)
     stats1 = stats_snapshot()
     if stats1:
         # H2D attribution (delta staging): bytes actually shipped per tick

@@ -1,6 +1,6 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Seven checkers, each
+Run as ``python -m goworld_tpu.analysis <paths>``.  Eight checkers, each
 an AST pass over the tree (stdlib-only -- no jax import needed):
 
 ===================  =====================================================
@@ -15,6 +15,8 @@ h2d-staging          full host-array uploads ride the _h2d/delta staging
                      seam
 fault-seam-coverage  declared fault seams are checked in package code and
                      exercised from tests/
+telemetry            every metric/span name is documented + tested; the
+                     telemetry package never syncs the device
 ===================  =====================================================
 
 See docs/static-analysis.md for the suppression story.
@@ -23,7 +25,7 @@ See docs/static-analysis.md for the suppression story.
 from __future__ import annotations
 
 from . import (coverage, determinism, dtypes, fault_seams, h2d_staging,
-               host_sync, wire_protocol)
+               host_sync, telemetry_rule, wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
 CHECKERS = [
@@ -34,6 +36,7 @@ CHECKERS = [
     coverage.check,
     h2d_staging.check,
     fault_seams.check,
+    telemetry_rule.check,
 ]
 
 __all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]

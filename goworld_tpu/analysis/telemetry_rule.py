@@ -1,0 +1,136 @@
+"""telemetry: the metric/span name catalog stays honest, and the
+telemetry package itself can never stall a tick.
+
+The unified telemetry layer (goworld_tpu/telemetry + docs/observability.md)
+makes the same promise the fault-seam catalog makes: every name you can
+grep out of a dashboard exists in code, is documented, and is pinned by a
+test.  Three ways it rots, mirrored from fault-seam-coverage:
+
+* production code names a span/metric (``trace.span("x")``,
+  ``telemetry.counter("x")``, ``opmon.Operation("x")``, ``Sample("x", ...)``)
+  that docs/observability.md never lists -- the catalog lies by omission
+  and operators cannot find what a series means;
+* a name is instrumented but no test references it -- renames and typos
+  ship silently, and the bit-exactness parity suite loses sight of the
+  instrumentation point;
+* the telemetry package grows a host sync or a module-level jax import --
+  the observability layer itself would then stall the tick it measures
+  (the one hard rule of the design: tracing reads clocks and counters
+  only).  The single allowed jax seam is the lazy import inside
+  ``trace.enable_jax_annotations``.
+
+Names are AST-extracted string first-arguments; "documented" is a
+word-boundary match over docs/observability.md, "tested" the same over
+tests/*.py (ctx.tests_reference).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .core import Context, Finding
+
+RULE = "telemetry"
+
+# call shapes that declare a telemetry name via their first string arg
+_NAMING_ATTRS = ("span", "lap", "counter", "gauge", "histogram", "Operation")
+# device/host-boundary calls that must never appear inside the telemetry
+# package (they synchronize or copy -- the tick would pay for its own
+# measurement)
+_SYNC_ATTRS = ("block_until_ready", "copy_to_host_async", "device_get",
+               "asarray", "addressable_data")
+
+
+def _telemetry_name(node: ast.Call) -> str | None:
+    """The name literal of a telemetry-naming call, if that's what this is.
+
+    Matches attribute spellings (``trace.span("x")``, ``_T.lap("x", t0)``,
+    ``telemetry.counter("x")``, ``opmon.Operation("x")``) plus the bare
+    ``Sample("x", ...)`` constructor collectors emit."""
+    if isinstance(node.func, ast.Attribute):
+        if node.func.attr not in _NAMING_ATTRS and node.func.attr != "Sample":
+            return None
+    elif isinstance(node.func, ast.Name):
+        if node.func.id != "Sample":
+            return None
+    else:
+        return None
+    if not node.args:
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _doc_text(ctx: Context) -> str:
+    path = os.path.join(ctx.root, "docs", "observability.md")
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return ""
+
+
+def _doc_references(docs: str, name: str) -> bool:
+    # dotted-word boundary: "tick" must not ride on "tick.seconds"
+    return re.search(r"(?<![\w.])" + re.escape(name) + r"(?![\w.])",
+                     docs) is not None
+
+
+def check(ctx: Context):
+    docs = None
+    seen: set[str] = set()
+    for sf in ctx.files:
+        rel = sf.rel
+        if rel.startswith("tests/") or "/analysis/" in rel:
+            continue
+        in_pkg = "/telemetry/" in rel or rel.startswith("telemetry/")
+        if in_pkg:
+            # purity: module-level jax import stalls every importer; the
+            # lazy import inside enable_jax_annotations is the one seam
+            for stmt in sf.tree.body:
+                if isinstance(stmt, ast.Import):
+                    mods = [a.name for a in stmt.names]
+                elif isinstance(stmt, ast.ImportFrom):
+                    mods = [stmt.module or ""]
+                else:
+                    continue
+                for m in mods:
+                    if m == "jax" or m.startswith("jax."):
+                        yield Finding(
+                            RULE, rel, stmt.lineno, stmt.col_offset,
+                            "module-level jax import in the telemetry "
+                            "package: import it lazily (the "
+                            "enable_jax_annotations seam) so telemetry "
+                            "never drags in a device runtime")
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if in_pkg and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_ATTRS:
+                yield Finding(
+                    RULE, rel, node.lineno, node.col_offset,
+                    f"host-sync call {node.func.attr!r} inside the "
+                    "telemetry package: tracing must read clocks and "
+                    "counters only, never synchronize the device")
+            name = _telemetry_name(node)
+            if name is None or name in seen:
+                continue
+            seen.add(name)
+            if docs is None:
+                docs = _doc_text(ctx)
+            if not _doc_references(docs, name):
+                yield Finding(
+                    RULE, rel, node.lineno, node.col_offset,
+                    f"telemetry name {name!r} is missing from "
+                    "docs/observability.md: the metric/span catalog must "
+                    "list every name production code can emit")
+            if ctx.tests_dir is not None and not ctx.tests_reference(name):
+                yield Finding(
+                    RULE, rel, node.lineno, node.col_offset,
+                    f"telemetry name {name!r} is never referenced from "
+                    "tests/: renames and typos in the instrumentation "
+                    "would ship silently")

@@ -24,7 +24,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
-from ... import consts
+from ... import consts, telemetry
 from ...config import ClusterConfig
 from ...netutil import Packet, PacketConnection, serve_tcp
 from ...proto import msgtypes as MT
@@ -110,6 +110,8 @@ class DispatcherService:
         self._listener = serve_tcp(self.addr, self._on_connection)
         self.addr = self._listener.getsockname()
         gwvar.set_var("component", f"dispatcher{self.id}")
+        if self.dispcfg.telemetry:
+            telemetry.enable()
         if self.dispcfg.http_port:
             binutil.setup_http_server(self.dispcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -148,7 +150,10 @@ class DispatcherService:
                 kind = None
             if kind == "packet":
                 try:
-                    self._handle(peer, pkt)
+                    # per-packet routing latency -> opmon table + registry
+                    # (p50/p99 at /debug/metrics, span in /debug/trace)
+                    with opmon.Operation("disp.route"):
+                        self._handle(peer, pkt)
                 except Exception:
                     self.log.exception("handler error")
             elif kind == "disconnect":

@@ -19,7 +19,7 @@ import queue
 import threading
 import time
 
-from ... import consts
+from ... import consts, telemetry
 from ...config import ClusterConfig
 from ...consts import COMPONENT_QUEUE_MAX
 from ...dispatchercluster import DispatcherCluster
@@ -121,6 +121,10 @@ class GameService:
             )
         self.cluster.start()
         gwvar.set_var("component", f"game{self.id}")
+        if self.gcfg.telemetry:
+            # route span stamps through the runtime clock so tick spans and
+            # timer deadlines read the same timeline (docs/observability.md)
+            telemetry.enable(clock=self.rt.now)
         if self.gcfg.http_port:
             binutil.setup_http_server(self.gcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -582,16 +586,17 @@ class GameService:
         if not self._dirty_clients:
             return
         clients, self._dirty_clients = self._dirty_clients, set()
-        for cli in clients:
-            if not cli.outbox:
-                continue
-            conn = self.cluster.by_gate(cli.gate_id)
-            if conn is None:
+        with opmon.Operation("game.outbox"):
+            for cli in clients:
+                if not cli.outbox:
+                    continue
+                conn = self.cluster.by_gate(cli.gate_id)
+                if conn is None:
+                    cli.outbox.clear()
+                    continue
+                for op in cli.outbox:
+                    self._send_client_op(conn, cli, op)
                 cli.outbox.clear()
-                continue
-            for op in cli.outbox:
-                self._send_client_op(conn, cli, op)
-            cli.outbox.clear()
 
     def _send_client_op(self, conn: GWConnection, cli: GameClient, op: tuple):
         kind = op[0]

@@ -21,7 +21,7 @@ import ssl
 import threading
 import time
 
-from ... import consts
+from ... import consts, telemetry
 from ...config import ClusterConfig
 from ...consts import COMPONENT_QUEUE_MAX
 from ...dispatchercluster import DispatcherCluster
@@ -119,6 +119,8 @@ class GateService:
             self.kcp_addr = self._kcp_server.addr
             self.log.info("gate kcp on %s", self.kcp_addr)
         gwvar.set_var("component", f"gate{self.id}")
+        if self.gatecfg.telemetry:
+            telemetry.enable()
         if self.gatecfg.http_port:
             binutil.setup_http_server(self.gatecfg.http_port)
         self.cluster.start()
@@ -219,13 +221,10 @@ class GateService:
     def _dispatch(self, kind, a, b):
         if kind == "client_pkt":
             # slow-op warning at 100 ms (reference: GateService.go:433-440);
-            # finally: the slow/broken packets are exactly the ones the
-            # stats must not miss
-            op = opmon.Operation("gate.client_pkt")
-            try:
+            # the context manager records on exceptions too -- the slow/
+            # broken packets are exactly the ones the stats must not miss
+            with opmon.Operation("gate.client_pkt", 0.1, self.log):
                 self._handle_client_packet(a, b)
-            finally:
-                op.finish(0.1, self.log)
         elif kind == "disp":
             self._handle_dispatcher_packet(b)
         elif kind == "client_new":

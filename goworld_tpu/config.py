@@ -40,6 +40,9 @@ class DispatcherConfig:
     host: str = "127.0.0.1"
     port: int = 16001
     http_port: int = 0
+    # enable the unified telemetry layer (metrics instruments + tick span
+    # tracing -- docs/observability.md); exposition rides http_port
+    telemetry: bool = False
 
 
 @dataclass
@@ -67,6 +70,9 @@ class GameConfig:
     boot_entity: str = ""
     log_file: str = ""
     http_port: int = 0
+    # enable the unified telemetry layer (metrics instruments + tick span
+    # tracing -- docs/observability.md); exposition rides http_port
+    telemetry: bool = False
 
 
 @dataclass
@@ -80,6 +86,9 @@ class GateConfig:
     position_sync_interval_ms: int = consts.POSITION_SYNC_INTERVAL_MS
     log_file: str = ""
     http_port: int = 0
+    # enable the unified telemetry layer (metrics instruments + tick span
+    # tracing -- docs/observability.md); exposition rides http_port
+    telemetry: bool = False
     # both set -> TLS on the TCP and WebSocket listeners (reference:
     # GateService.go:97-118)
     tls_cert: str = ""

@@ -36,6 +36,8 @@ ENTITY_SAVE_INTERVAL_S = 300  # reference: read_config.go:28 (5 min)
 
 # ops
 OPMON_DUMP_INTERVAL_S = 60.0  # periodic op-table log (reference: opmon.go:26-35)
+TRACE_RING_SPANS = 65536  # completed spans kept for /debug/trace exports
+TRACE_TICK_MARKS = 1024   # tick boundaries kept for last-N-ticks windowing
 
 # AOI
 DEFAULT_AOI_DISTANCE = 100.0  # reference: unity_demo/MySpace.go:26

@@ -31,8 +31,9 @@ import time
 import zlib
 from typing import Callable
 
-from . import faults
+from . import faults, telemetry
 from .netutil import PacketConnection, Packet, connect_tcp
+from .telemetry.metrics import Sample
 from .proto import GWConnection
 from .utils import gwlog
 
@@ -59,6 +60,8 @@ class DispatcherCluster:
     (from the connect thread) every time a connection (re)establishes, so the
     owner re-sends its registration.
     """
+
+    _next_telemetry_id = 0  # distinguishes live clusters in metric labels
 
     def __init__(
         self,
@@ -97,6 +100,11 @@ class DispatcherCluster:
             threading.Thread(target=self._maintain, args=(i,), daemon=True)
             for i in range(len(addrs))
         ]
+        # /debug/metrics exposes status() through the registry; weak so a
+        # dropped cluster (tests build many) unregisters itself
+        self._telemetry_id = DispatcherCluster._next_telemetry_id
+        DispatcherCluster._next_telemetry_id += 1
+        telemetry.register_collector(self._telemetry_collect, weak=True)
 
     def start(self):
         for t in self._threads:
@@ -138,6 +146,33 @@ class DispatcherCluster:
             d["connected"] = self.conns[i] is not None
             d["pending"] = len(self._pending[i])
             out.append(d)
+        return out
+
+    def _telemetry_collect(self) -> list[Sample]:
+        """status() rendered as registry samples, one series per link
+        (docs/observability.md: the disp.* catalog)."""
+        out = []
+        for i, s in enumerate(self.status()):
+            labels = {"cluster": str(self._telemetry_id),
+                      "tag": self.tag, "disp": str(i)}
+            out.append(Sample("disp.connected", "gauge",
+                              1.0 if s["connected"] else 0.0, labels,
+                              "1 while the dispatcher link is up"))
+            out.append(Sample("disp.attempts", "gauge",
+                              float(s["attempts"]), labels,
+                              "consecutive failed reconnect attempts"))
+            out.append(Sample("disp.backoff_s", "gauge",
+                              float(s["backoff_s"]), labels,
+                              "current reconnect backoff"))
+            out.append(Sample("disp.pending", "gauge",
+                              float(s["pending"]), labels,
+                              "payloads buffered for outage replay"))
+            out.append(Sample("disp.replayed", "counter",
+                              float(s["replayed"]), labels,
+                              "payloads replayed after reconnect"))
+            out.append(Sample("disp.dropped", "counter",
+                              float(s["dropped"]), labels,
+                              "payloads dropped oldest-first on overflow"))
         return out
 
     # -- outage buffering --------------------------------------------------

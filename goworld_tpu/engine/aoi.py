@@ -35,9 +35,11 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .. import faults
+from .. import faults, telemetry
 from ..ops import aoi_predicate as P
 from ..ops.aoi_oracle import CPUAOIOracle
+from ..telemetry import trace as _T
+from ..telemetry.metrics import Sample
 from ..ops import events as EV
 
 # A space handle is stable for the space's lifetime; slots inside a bucket are
@@ -279,6 +281,8 @@ class AOIEngine:
     the engine-level multi-chip path (see engine/aoi_mesh).  Without it, tpu
     buckets are single-device."""
 
+    _next_telemetry_id = 0
+
     def __init__(self, default_backend: str = "cpu",
                  oracle_algorithm: str = "sweep", mesh=None,
                  pipeline: bool = False, delta_staging: bool = True,
@@ -311,6 +315,13 @@ class AOIEngine:
         # the mesh bucket implements the same contract per chip)
         self.pipeline = pipeline
         self._buckets: dict[tuple[str, int], _Bucket] = {}
+        # unified telemetry: the per-bucket stats/perf dicts surface at
+        # /debug/metrics under aoi.* dotted names.  Registered weakly so
+        # the registry never keeps a dead engine (and its device state)
+        # alive; the label tells concurrent engines apart.
+        self._telemetry_id = AOIEngine._next_telemetry_id
+        AOIEngine._next_telemetry_id += 1
+        telemetry.register_collector(self._telemetry_collect, weak=True)
         if default_backend in ("tpu", "auto"):
             # fail FAST at process boot, not on the first space's first
             # tick: a game configured for tpu whose jax backend is broken
@@ -454,6 +465,37 @@ class AOIEngine:
             getattr(b, "_inflight", None) is not None
             for b in self._buckets.values()
         )
+
+    def _telemetry_collect(self):
+        """Registry collector: bucket stats/perf summed across this
+        engine's buckets (docs/observability.md metric catalog).
+        ``calc_level`` reports the WORST bucket -- any demoted calculator
+        should page, however many healthy ones sit next to it."""
+        lbl = {"engine": str(self._telemetry_id)}
+        stats: dict[str, float] = {}
+        perf: dict[str, float] = {}
+        calc_level = 0
+        for b in self._buckets.values():
+            for k, v in getattr(b, "stats", {}).items():
+                if k == "calc_level":
+                    calc_level = max(calc_level, v)
+                else:
+                    stats[k] = stats.get(k, 0) + v
+            for k, v in getattr(b, "perf", {}).items():
+                perf[k] = perf.get(k, 0.0) + v
+        out = [Sample("aoi.buckets", "gauge", len(self._buckets), lbl,
+                      "live AOI buckets in this engine"),
+               Sample("aoi.calc_level", "gauge", calc_level, lbl,
+                      "worst calculator fallback level "
+                      "(0=pallas 1=dense 2=host oracle)")]
+        for k in sorted(stats):
+            out.append(Sample("aoi." + k, "counter", stats[k], lbl,
+                              "summed per-bucket AOI stat"))
+        for k in sorted(perf):
+            out.append(Sample("aoi." + k.replace("_s", "_seconds"), "counter",
+                              perf[k], lbl,
+                              "cumulative per-phase flush time"))
+        return out
 
     def take_events(self, h: SpaceAOIHandle):
         """(enter_pairs, leave_pairs) for this space from the last flush."""
@@ -614,9 +656,11 @@ class _CPUBucket(_Bucket):
 
     def flush(self) -> None:
         t0 = time.perf_counter()
+        _ts = _T.t()
         for slot, (x, z, r, act) in self._staged.items():
             self._events[slot] = self._oracles[slot].step(x, z, r, act)
         self._staged.clear()
+        _T.lap("aoi.kernel", _ts)
         self.perf["calc_s"] += time.perf_counter() - t0
 
     def peek_words(self, slot: int) -> np.ndarray:
@@ -955,6 +999,7 @@ class _TPUBucket(_Bucket):
             return
 
         t_stage0 = time.perf_counter()
+        _ts = _T.t()
         slots = sorted(self._staged)
         s_n = len(slots)
         sl = np.array(slots, np.intp)
@@ -990,6 +1035,8 @@ class _TPUBucket(_Bucket):
         if self._mirror is not None and not sub.all():
             self._mirror_stale.update(s for s in slots if s in self._unsub)
         self._stage_inputs(sl, old_x, old_z, old_r, old_act)
+        _T.lap("aoi.stage", _ts)
+        _tk = _T.t()
         self._fault_phase = "kernel"
         faults.check("aoi.kernel")
         out = _fused_bucket_step(
@@ -1001,6 +1048,7 @@ class _TPUBucket(_Bucket):
         (self.prev, new, chg, g_vals, g_nv, g_lane, g_csel,
          rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg, exc_new,
          scalars) = out
+        _T.lap("aoi.kernel", _tk)
         all_unsub = not sub.any()
         if not all_unsub:
             scalars.copy_to_host_async()
@@ -1194,6 +1242,7 @@ class _TPUBucket(_Bucket):
         c, W = self.capacity, self.W
         s_n = len(slots)
         self.stats["host_ticks"] += 1
+        _th = _T.t()
         self._refresh_stale_rows()
         sl = np.array(slots, np.intp)
         sub = self._hsub[sl]
@@ -1218,6 +1267,7 @@ class _TPUBucket(_Bucket):
                               "payload": (chg_vals, ent_vals, gidx, s_n)}
         else:
             self._publish(slots, epochs, chg_vals, ent_vals, gidx, s_n)
+        _T.lap("aoi.host_tick", _th)
 
     def _flush_oracle(self) -> None:
         """Level-2 fallback flush: the device is out of the loop entirely;
@@ -1261,6 +1311,7 @@ class _TPUBucket(_Bucket):
         # now
         faults.check("aoi.fetch")  # stallable: a delayed host sync
         t_f0 = time.perf_counter()
+        _tf = _T.t()
         poisoned = False
         if rec.get("all_unsub"):
             nd = mcc = base_row = n_esc = exc_n = 0
@@ -1297,6 +1348,7 @@ class _TPUBucket(_Bucket):
             chg_vals = chg_h[gidx]
             ent_vals = chg_vals & new_h[gidx]
             self.perf["fetch_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.fetch", _tf)
         elif nd == 0 and exc_n == 0:
             # quiet tick (or every staged slot unsubscribed): the stream is
             # empty by construction -- the scalars above are the ONLY fetch
@@ -1304,6 +1356,7 @@ class _TPUBucket(_Bucket):
             ent_vals = np.empty(0, np.uint32)
             gidx = np.empty(0, np.int64)
             self.perf["fetch_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.fetch", _tf)
         elif nd > mc or mcc > kcap:
             # caps exceeded: recover this tick from the full diff, then grow
             # the caps so the next tick extracts on device again
@@ -1317,6 +1370,7 @@ class _TPUBucket(_Bucket):
             chg_vals = chg_h[gidx]
             ent_vals = chg_vals & new_h[gidx]
             self.perf["fetch_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.fetch", _tf)
         elif n_esc > self._max_gaps or exc_n > self._max_exc:
             # encode overflow (pathological churn): rebuild from the raw
             # grids kept on device
@@ -1330,6 +1384,7 @@ class _TPUBucket(_Bucket):
             ent_vals = chg_vals & nh[valid]
             gidx = (ch[:, None].astype(np.int64) * _LANES + lh)[valid]
             self.perf["fetch_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.fetch", _tf)
         else:
             # the common path fetches the ENCODED stream: ~5 B per dirty
             # chunk + 12 B per exception, overlapped slice transfers
@@ -1348,12 +1403,16 @@ class _TPUBucket(_Bucket):
                     a.copy_to_host_async()
                 hb = [np.asarray(a) for a in slices]
             self.perf["fetch_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.fetch", _tf)
             t_f0 = time.perf_counter()
+            _td = _T.t()
             chg_vals, ent_vals, gidx = EV.decode_row_stream(
                 hb[0], hb[1], hb[2].astype(np.uint16), base_row, nd,
                 _LANES, hb[3], hb[4], hb[5], hb[6])
             self.perf["decode_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.diff", _td)
         t_f0 = time.perf_counter()
+        _td = _T.t()
         # refit the next dispatch's optimistic prefetch to this tick
         self._pred = (
             max(512, -(-nd * 5 // 4 // 128) * 128),
@@ -1392,6 +1451,7 @@ class _TPUBucket(_Bucket):
         self._scratch.setdefault(rec["key"], rec["scratch"])
         self._publish(slots, rec["epochs"], chg_vals, ent_vals, gidx, s_n)
         self.perf["decode_s"] += time.perf_counter() - t_f0
+        _T.lap("aoi.diff", _td)
 
     def _apply_deferred_mirror_ops(self) -> None:
         """Clears issued after a tick's dispatch apply now, AFTER its

@@ -61,6 +61,7 @@ import time
 import numpy as np
 
 from .. import faults
+from ..telemetry import trace as _T
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
 from .aoi import (_Bucket, _CapDecay, _device_fault, _packed_predicate,
@@ -604,6 +605,7 @@ class _MeshTPUBucket(_Bucket):
 
     def _flush_device(self) -> None:  # gwlint: allow[host-sync] -- flush epilogue hands results to the harvest drain
         t0 = time.perf_counter()
+        _ts = _T.t()
         self._fault_phase = "stage"
         if self.pipeline and self._inflight is not None \
                 and not self._inflight.get("all_unsub") \
@@ -647,6 +649,8 @@ class _MeshTPUBucket(_Bucket):
                 s for s in staged_slots if s in self._unsub)
         key, scratch = self._get_scratch()
         self._stage_xz(sl, old_x, old_z, old_r, old_act)
+        _T.lap("aoi.stage", _ts)
+        _tk = _T.t()
         self._fault_phase = "kernel"
         faults.check("aoi.kernel")
         out = self._sharded_step()(
@@ -655,6 +659,7 @@ class _MeshTPUBucket(_Bucket):
             self._h2d("sub", self._hsub))
         (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos,
          woff, esc_rows, exc_gidx, exc_chg, exc_new, scalars) = out
+        _T.lap("aoi.kernel", _tk)
         self.prev = new  # the step's new words ARE next tick's prev
         # every staged slot unsubscribed (and unstaged slots re-step
         # identical inputs -> zero diff): the stream is empty by
@@ -849,6 +854,7 @@ class _MeshTPUBucket(_Bucket):
         c, W = self.capacity, self.W
         s_n = len(slots)
         self.stats["host_ticks"] += 1
+        _th = _T.t()
         self._refresh_stale_rows()
         sl = np.array(slots, np.intp)
         sub = self._hsub[sl]
@@ -872,6 +878,7 @@ class _MeshTPUBucket(_Bucket):
                               "payload": (chg_vals, ent_vals, gidx, s_n)}
         else:
             self._publish(slots, epochs, chg_vals, ent_vals, gidx, s_n)
+        _T.lap("aoi.host_tick", _th)
 
     def _flush_oracle(self) -> None:
         """Level-2 fallback flush: the device is out of the loop entirely;
@@ -946,6 +953,7 @@ class _MeshTPUBucket(_Bucket):
          exc_new) = rec["streams"]
         faults.check("aoi.fetch")  # stallable: a delayed host sync
         t0 = time.perf_counter()
+        _tf = _T.t()
         poisoned = False
         if rec.get("all_unsub"):
             scal_h = np.zeros((self.n_dev, 5), np.int64)
@@ -971,6 +979,7 @@ class _MeshTPUBucket(_Bucket):
                     scal_h.tolist())
                 poisoned = True
         self.perf["fetch_s"] += time.perf_counter() - t0
+        _T.lap("aoi.fetch", _tf)
         pf = rec["prefetch"]
         all_c, all_e, all_g = [], [], []
         grew = False
@@ -979,6 +988,7 @@ class _MeshTPUBucket(_Bucket):
         for d in range(self.n_dev):
             if poisoned:
                 t0 = time.perf_counter()
+                _tf = _T.t()
                 lo = d * s_local
                 chg_h = np.asarray(chg[lo:lo + s_local]).reshape(-1)
                 gidx = np.nonzero(chg_h)[0]
@@ -994,6 +1004,7 @@ class _MeshTPUBucket(_Bucket):
                         self.prev[lo:lo + s_local]).reshape(-1)
                     ent_vals = chg_vals & new_h[gidx]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
                 all_c.append(chg_vals)
                 all_e.append(ent_vals)
                 all_g.append(np.asarray(gidx, np.int64)
@@ -1003,6 +1014,7 @@ class _MeshTPUBucket(_Bucket):
             if nd == 0 and exc_n == 0:
                 continue
             t0 = time.perf_counter()
+            _tf = _T.t()
             if nd > mc or mcc > kcap:
                 # this chip's stream is incomplete: recover from its raw
                 # diff grid, grow the caps for the next flush.  self.prev
@@ -1019,6 +1031,7 @@ class _MeshTPUBucket(_Bucket):
                 chg_vals = chg_h[gidx]
                 ent_vals = chg_vals & new_h[gidx]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
             elif n_esc > mg or exc_n > mx:
                 # encode overflow: rebuild from the kept chunk grids
                 self._max_gaps = max(mg, 2 * n_esc)
@@ -1034,6 +1047,7 @@ class _MeshTPUBucket(_Bucket):
                 ent_vals = chg_vals & nh[valid]
                 gidx = (ch[:, None].astype(np.int64) * _LANES + lh)[valid]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
             else:
                 if pf is not None and pf[0] >= nd and pf[1] >= n_esc \
                         and pf[2] >= exc_n:
@@ -1049,11 +1063,14 @@ class _MeshTPUBucket(_Bucket):
                         exc_chg[d * mx:d * mx + max(exc_n, 1)],
                         exc_new[d * mx:d * mx + max(exc_n, 1)])]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
                 t0 = time.perf_counter()
+                _td = _T.t()
                 chg_vals, ent_vals, gidx = EV.decode_row_stream(
                     hb[0], hb[1], hb[2].astype(np.uint16), base_row, nd,
                     _LANES, hb[3], hb[4], hb[5], hb[6])
                 self.perf["decode_s"] += time.perf_counter() - t0
+                _T.lap("aoi.diff", _td)
             peak = [max(peak[0], nd), max(peak[1], n_esc),
                     max(peak[2], exc_n)]
             peak_mcc = max(peak_mcc, mcc)
@@ -1081,6 +1098,7 @@ class _MeshTPUBucket(_Bucket):
             max(256, -(-(peak[2] + 1) * 5 // 4 // 256) * 256),
         )
         t0 = time.perf_counter()
+        _td = _T.t()
         epochs = rec["epochs"]
         live = np.fromiter(
             (self._slot_epoch.get(s, 0) == epochs.get(s, 0)
@@ -1133,3 +1151,4 @@ class _MeshTPUBucket(_Bucket):
         if rec["key"] == (self.s_max, self._max_chunks, self._kcap):
             self._scratch.setdefault(rec["key"], rec["scratch"])
         self.perf["decode_s"] += time.perf_counter() - t0
+        _T.lap("aoi.diff", _td)

@@ -47,6 +47,7 @@ import time
 import numpy as np
 
 from .. import faults
+from ..telemetry import trace as _T
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
 from .aoi import _Bucket, _CapDecay, _device_fault, _packed_predicate
@@ -452,6 +453,7 @@ class _RowShardTPUBucket(_Bucket):
         if not self._staged:
             return
         t0 = time.perf_counter()
+        _ts = _T.t()
         self._restage_shadows()
         self._tick_inflight = True  # a restaged tick awaits its events
         old_x, old_z, old_r, old_act = self._cur_old
@@ -459,6 +461,8 @@ class _RowShardTPUBucket(_Bucket):
         key, scratch = self._get_scratch()
         self._stage_xz(old_x, old_z, old_r, old_act)
         sub = self._h2d("sub", np.asarray(self._subscribed), replicated=True)
+        _T.lap("aoi.stage", _ts)
+        _tk = _T.t()
         self._fault_phase = "kernel"
         faults.check("aoi.kernel")
         out = self._sharded_step()(
@@ -470,6 +474,7 @@ class _RowShardTPUBucket(_Bucket):
             sub)
         (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos, woff,
          esc_rows, exc_gidx, exc_chg, exc_new, scalars) = out
+        _T.lap("aoi.kernel", _tk)
         self.prev = new
         scalars.copy_to_host_async()
         # optimistic async prefetch of the streams at recent sizes -- the
@@ -519,6 +524,7 @@ class _RowShardTPUBucket(_Bucket):
          exc_new) = rec["streams"]
         faults.check("aoi.fetch")  # stallable: a delayed host sync
         t0 = time.perf_counter()
+        _tf = _T.t()
         scal_h = faults.filter("aoi.scalars",
                                np.asarray(rec["scalars"]))  # [n_dev, 5]
         poisoned = False
@@ -542,6 +548,7 @@ class _RowShardTPUBucket(_Bucket):
                 scal_h.tolist())
             poisoned = True
         self.perf["fetch_s"] += time.perf_counter() - t0
+        _T.lap("aoi.fetch", _tf)
         pf = rec["prefetch"]
         all_c, all_e, all_g = [], [], []
         grew = False
@@ -550,6 +557,7 @@ class _RowShardTPUBucket(_Bucket):
         for d in range(self.n_dev):
             if poisoned:
                 t0 = time.perf_counter()
+                _tf = _T.t()
                 lo = d * cl
                 chg_h = np.asarray(chg[lo:lo + cl]).reshape(-1)
                 gidx = np.nonzero(chg_h)[0]
@@ -557,6 +565,7 @@ class _RowShardTPUBucket(_Bucket):
                 new_h = np.asarray(self.prev[lo:lo + cl]).reshape(-1)
                 ent_vals = chg_vals & new_h[gidx]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
                 all_c.append(chg_vals)
                 all_e.append(ent_vals)
                 all_g.append(np.asarray(gidx, np.int64)
@@ -566,6 +575,7 @@ class _RowShardTPUBucket(_Bucket):
             if nd == 0 and exc_n == 0:
                 continue
             t0 = time.perf_counter()
+            _tf = _T.t()
             if nd > mc or mcc > kcap:
                 # incomplete stream: recover from this chip's raw diff grid
                 self._max_chunks = max(self._max_chunks, 2 * nd)
@@ -578,6 +588,7 @@ class _RowShardTPUBucket(_Bucket):
                 chg_vals = chg_h[gidx]
                 ent_vals = chg_vals & new_h[gidx]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
             elif n_esc > mg or exc_n > mx:
                 self._max_gaps = max(mg, 2 * n_esc)
                 self._max_exc = max(mx, 2 * exc_n)
@@ -592,6 +603,7 @@ class _RowShardTPUBucket(_Bucket):
                 ent_vals = chg_vals & nh[valid]
                 gidx = (ch[:, None].astype(np.int64) * _LANES + lh)[valid]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
             else:
                 if pf is not None and pf[0] >= nd and pf[1] >= n_esc \
                         and pf[2] >= exc_n:
@@ -607,11 +619,14 @@ class _RowShardTPUBucket(_Bucket):
                         exc_chg[d * mx:d * mx + max(exc_n, 1)],
                         exc_new[d * mx:d * mx + max(exc_n, 1)])]
                 self.perf["fetch_s"] += time.perf_counter() - t0
+                _T.lap("aoi.fetch", _tf)
                 t0 = time.perf_counter()
+                _td = _T.t()
                 chg_vals, ent_vals, gidx = EV.decode_row_stream(
                     hb[0], hb[1], hb[2].astype(np.uint16), base_row, nd,
                     _LANES, hb[3], hb[4], hb[5], hb[6])
                 self.perf["decode_s"] += time.perf_counter() - t0
+                _T.lap("aoi.diff", _td)
             peak = [max(peak[0], nd), max(peak[1], n_esc),
                     max(peak[2], exc_n)]
             peak_mcc = max(peak_mcc, mcc)
@@ -635,6 +650,7 @@ class _RowShardTPUBucket(_Bucket):
             max(256, -(-(peak[2] + 1) * 5 // 4 // 256) * 256),
         )
         t0 = time.perf_counter()
+        _td = _T.t()
         empty = np.empty((0, 2), np.int32)
         if all_c:
             pe, pl = EV.expand_classified_host(
@@ -652,6 +668,7 @@ class _RowShardTPUBucket(_Bucket):
         if rec["key"] == (self._max_chunks, self._kcap):
             self._scratch.setdefault(rec["key"], rec["scratch"])
         self.perf["decode_s"] += time.perf_counter() - t0
+        _T.lap("aoi.diff", _td)
 
     # -- fault recovery (docs/robustness.md): no standing mirror at this
     # size, so the durable old state is reconstructed on demand -- the
@@ -738,6 +755,7 @@ class _RowShardTPUBucket(_Bucket):
         sharded step: the global flat word order equals the per-chip
         extraction order after the chip-offset shift."""
         self.stats["host_ticks"] += 1
+        _th = _T.t()
         new = _packed_predicate(self._hx, self._hz, self._hr, self._hact)
         empty = np.empty((0, 2), np.int32)
         if self._subscribed:
@@ -760,6 +778,7 @@ class _RowShardTPUBucket(_Bucket):
         self._host_prev = new
         self._seed_prev = None
         self._cur_old = None
+        _T.lap("aoi.host_tick", _th)
 
     def _flush_oracle(self) -> None:
         """Level-2 fallback flush: the device is out of the loop entirely;

@@ -22,13 +22,19 @@ from __future__ import annotations
 import time
 from typing import Callable
 
-from .. import faults
+from .. import faults, telemetry
+from ..telemetry import trace as _trace
 from ..utils.crontab import Crontab
 from .aoi import AOIEngine
 from .entity import SYNC_NEIGHBORS, SYNC_OWN, Entity
 from .manager import EntityManager
 from .post import PostQueue
 from .timers import TimerQueue
+
+# whole-tick latency histogram (pow2 buckets -> p50/p99 at /debug/metrics);
+# a no-op while telemetry is disabled
+_TICK_SECONDS = telemetry.histogram(
+    "tick.seconds", "whole-tick wall time (timers+aoi+sync+post)")
 
 
 class Runtime:
@@ -43,11 +49,19 @@ class Runtime:
         aoi_tpu_min_capacity: int = 4096,
         aoi_rowshard_min_capacity: int = 65536,
         fault_plan: "faults.FaultPlan | str | None" = None,
+        telemetry_on: bool = False,
     ):
         # Install BEFORE AOIEngine construction: buckets decide at __init__
         # whether to keep eager host mirrors (faults.active()).
         if fault_plan is not None:
             faults.install(fault_plan)
+        # The injectable clock doubles as the span clock (docs/
+        # observability.md): enabling telemetry through the Runtime routes
+        # every span timestamp through ``now``, so tests drive tracing
+        # deterministically.  False leaves process-global state untouched
+        # (another component may have enabled it already).
+        if telemetry_on:
+            telemetry.enable(clock=now)
         self.now = now
         self.on_error = on_error or self._default_on_error
         self.timers = TimerQueue(now)
@@ -84,11 +98,18 @@ class Runtime:
     # -- the tick ----------------------------------------------------------
     def tick(self):
         self.tick_count += 1
-        self.timers.tick(self.on_error)
-        self.crontab.maybe_check()
-        self._aoi_phase()
-        self._sync_phase()
-        self.post.tick(self.on_error)
+        _trace.mark_tick(self.tick_count)
+        _t0 = _trace.t()
+        with _trace.span("tick.timers"):
+            self.timers.tick(self.on_error)
+            self.crontab.maybe_check()
+        with _trace.span("tick.aoi"):
+            self._aoi_phase()
+        with _trace.span("tick.sync"):
+            self._sync_phase()
+        with _trace.span("tick.post"):
+            self.post.tick(self.on_error)
+        _TICK_SECONDS.observe(_trace.lap("tick", _t0))
 
     def _aoi_phase(self):
         spaces = list(self.entities.spaces.values())
@@ -99,9 +120,11 @@ class Runtime:
         # is staged (trailing flush); events can land on any AOI space, not
         # just the ones staged this tick
         if staged or self.aoi.has_pending():
-            self.aoi.flush()
-            for sp in spaces:
-                sp.dispatch_aoi_events()
+            with _trace.span("aoi.flush"):
+                self.aoi.flush()
+            with _trace.span("aoi.emit"):
+                for sp in spaces:
+                    sp.dispatch_aoi_events()
         # slots freed last tick become reusable only NOW, after event
         # delivery: with a pipelined calculator, events replayed this phase
         # may reference a slot freed last tick, and recycling before the

@@ -276,3 +276,35 @@ _env = os.environ.get("GW_FAULT_PLAN")
 if _env:
     _PLAN = parse(_env)
 del _env
+
+
+def _telemetry_collect():
+    """Fault-injection state as registry samples (/debug/metrics): whether
+    a plan is live, per-seam pass counts, and per-seam faults actually
+    taken.  Imported lazily below so the telemetry package never becomes a
+    hard dependency of the seam hook itself."""
+    from .telemetry.metrics import Sample
+
+    p = _PLAN
+    out = [Sample("faults.active", "gauge", 1.0 if p is not None else 0.0,
+                  None, "1 while a fault plan is installed")]
+    if p is None:
+        return out
+    with p._lock:
+        counts = dict(p.counts)
+        fired: dict[str, int] = {}
+        for f in p.fired:
+            fired[f["seam"]] = fired.get(f["seam"], 0) + 1
+    for seam, n in sorted(counts.items()):
+        out.append(Sample("faults.occurrences", "counter", float(n),
+                          {"seam": seam}, "times the seam was crossed"))
+    for seam, n in sorted(fired.items()):
+        out.append(Sample("faults.fired", "counter", float(n),
+                          {"seam": seam}, "injected faults taken"))
+    return out
+
+
+from .telemetry import register_collector as _register_collector  # noqa: E402
+
+_register_collector(_telemetry_collect)
+del _register_collector

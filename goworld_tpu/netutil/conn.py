@@ -126,39 +126,38 @@ class PacketConnection:
                 batch, self._pending = self._pending, []
             if not batch:
                 return 0
-            op = opmon.Operation("conn.flush")
-            out = bytearray()
-            for payload in batch:
-                if self._threshold and len(payload) >= self._threshold:
-                    z = self._compressor.compress(payload)
-                    if len(z) < len(payload):
-                        out += _u32.pack(len(z) | _COMPRESSED_BIT)
-                        out += z
-                        continue
-                out += _u32.pack(len(payload))
-                out += payload
-            # A timed-out sendall leaves a PARTIAL frame on the wire and
-            # permanently desyncs the peer's parser (sendall's documented
-            # undefined-state caveat), so the write itself must always run
-            # blocking; the caller's timeout is restored for recv use.
-            timeout = self._sock.gettimeout()
-            if timeout is not None:
-                self._sock.settimeout(None)
-            try:
-                if spec is not None and spec.kind == "partial":
-                    # Write a prefix of the batch, then drop the link: the
-                    # peer's FrameParser is left mid-frame, exactly like a
-                    # connection cut between TCP segments.
-                    frac = spec.arg if spec.arg is not None else 0.5
-                    self._sock.sendall(bytes(out[: int(len(out) * frac)]))
-                    self.close()
-                    raise ConnectionResetError(
-                        "injected partial write (link dropped mid-frame)")
-                self._sock.sendall(out)
-            finally:
-                if timeout is not None and not self.closed:
-                    self._sock.settimeout(timeout)
-                op.finish()
+            with opmon.Operation("conn.flush"):
+                out = bytearray()
+                for payload in batch:
+                    if self._threshold and len(payload) >= self._threshold:
+                        z = self._compressor.compress(payload)
+                        if len(z) < len(payload):
+                            out += _u32.pack(len(z) | _COMPRESSED_BIT)
+                            out += z
+                            continue
+                    out += _u32.pack(len(payload))
+                    out += payload
+                # A timed-out sendall leaves a PARTIAL frame on the wire and
+                # permanently desyncs the peer's parser (sendall's documented
+                # undefined-state caveat), so the write itself must always
+                # run blocking; the caller's timeout is restored for recv.
+                timeout = self._sock.gettimeout()
+                if timeout is not None:
+                    self._sock.settimeout(None)
+                try:
+                    if spec is not None and spec.kind == "partial":
+                        # Write a prefix of the batch, then drop the link:
+                        # the peer's FrameParser is left mid-frame, exactly
+                        # like a connection cut between TCP segments.
+                        frac = spec.arg if spec.arg is not None else 0.5
+                        self._sock.sendall(bytes(out[: int(len(out) * frac)]))
+                        self.close()
+                        raise ConnectionResetError(
+                            "injected partial write (link dropped mid-frame)")
+                    self._sock.sendall(out)
+                finally:
+                    if timeout is not None and not self.closed:
+                        self._sock.settimeout(timeout)
             return len(out)
 
     # -- recv side ---------------------------------------------------------

@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..telemetry import trace as _T
+
 _MIN_PACKET = 64
 
 _apply_impl = None
@@ -107,4 +109,7 @@ def apply_packet(dx, dz, rows, cols, xv, zv):
             return delta_scatter(dx, dz, rows, cols, xv, zv)
 
         _apply_impl = impl
-    return _apply_impl(dx, dz, rows, cols, xv, zv)
+    _th = _T.t()
+    out = _apply_impl(dx, dz, rows, cols, xv, zv)
+    _T.lap("aoi.h2d", _th)
+    return out

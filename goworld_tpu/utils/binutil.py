@@ -6,6 +6,11 @@ Endpoints (the Python analog of Go's pprof/expvar surface):
 
   * ``/debug/vars``    -- gwvar snapshot as JSON (expvar analog)
   * ``/debug/opmon``   -- opmon per-operation stats as JSON
+  * ``/debug/metrics`` -- unified telemetry registry, Prometheus text
+                          exposition (docs/observability.md)
+  * ``/debug/trace``   -- buffered spans as Chrome trace-event JSON
+                          (``?ticks=N`` windows to the last N ticks;
+                          save the body and load it in Perfetto)
   * ``/debug/stacks``  -- current stack of every thread, plain text
                           (the goroutine-dump analog of /debug/pprof)
   * ``/debug/health``  -- 200 "ok" liveness probe
@@ -19,7 +24,10 @@ import sys
 import threading
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
+from .. import telemetry
+from ..telemetry import trace as gwtrace
 from . import gwlog, gwvar, opmon
 
 log = gwlog.logger("binutil")
@@ -32,6 +40,19 @@ class _DebugHandler(BaseHTTPRequestHandler):
             self._json(gwvar.snapshot())
         elif path == "/debug/opmon":
             self._json(opmon.dump())
+        elif path == "/debug/metrics":
+            self._reply(telemetry.render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/debug/trace":
+            qs = parse_qs(self.path.partition("?")[2])
+            ticks = None
+            if qs.get("ticks"):
+                try:
+                    ticks = max(1, int(qs["ticks"][0]))
+                except ValueError:
+                    self.send_error(400, "bad ticks param")
+                    return
+            self._json(gwtrace.export_chrome_trace(last_ticks=ticks))
         elif path == "/debug/stacks":
             self._text(_format_stacks())
         elif path in ("/debug/health", "/healthz"):

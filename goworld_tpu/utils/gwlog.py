@@ -1,11 +1,18 @@
 """Leveled logging with per-component source tags (reference role:
 engine/gwlog -- zap-based; here stdlib logging with the same usage shape:
 ``gwlog.logger("game1").info(...)``, level from config/CLI, optional file
-output, and a parseable readiness tag for the CLI's start barrier)."""
+output, and a parseable readiness tag for the CLI's start barrier).
+
+``setup(json_lines=True)`` (or ``GW_LOG_JSON=1``) switches to one JSON
+record per line -- ts/level/component/msg -- so component logs are
+machine-parseable next to /debug/metrics.  The readiness line stays
+greppable either way: ``READY_TAG`` rides inside the rendered ``msg``."""
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 
 # the CLI start barrier greps for this tag (reference: consts.go:133-137
@@ -15,8 +22,30 @@ READY_TAG = "COMPONENT_READY"
 _configured = False
 
 
-def setup(level: str = "info", logfile: str | None = None):
+class _JsonLinesFormatter(logging.Formatter):
+    """One compact JSON object per record: ts (unix seconds), level,
+    component (the ``gw.<tag>`` logger name), msg.  Keys are sorted so the
+    line layout is stable for downstream parsers."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        return json.dumps(
+            {
+                "ts": round(record.created, 6),
+                "level": record.levelname,
+                "component": record.name,
+                "msg": record.getMessage(),
+            },
+            sort_keys=True,
+            separators=(",", ":"),
+            default=str,
+        )
+
+
+def setup(level: str = "info", logfile: str | None = None,
+          json_lines: bool | None = None):
     global _configured
+    if json_lines is None:
+        json_lines = os.environ.get("GW_LOG_JSON", "") in ("1", "true", "yes")
     root = logging.getLogger("gw")
     root.setLevel(getattr(logging, level.upper(), logging.INFO))
     root.handlers.clear()
@@ -24,7 +53,9 @@ def setup(level: str = "info", logfile: str | None = None):
         logging.FileHandler(logfile) if logfile else logging.StreamHandler(sys.stderr)
     )
     handler.setFormatter(
-        logging.Formatter(
+        _JsonLinesFormatter()
+        if json_lines
+        else logging.Formatter(
             "%(asctime)s %(levelname).1s %(name)s: %(message)s", "%H:%M:%S"
         )
     )

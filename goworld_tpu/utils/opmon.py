@@ -1,5 +1,13 @@
 """In-process operation monitor (reference: engine/opmon -- count/avg/max per
-named operation, slow-op warnings, periodic dump)."""
+named operation, slow-op warnings, periodic dump).
+
+Each op also feeds a pow2-bucket latency histogram (telemetry.metrics), so
+``dump()`` reports p50/p99 alongside avg/max, and the whole table doubles
+as a telemetry collector: ``/debug/opmon`` and ``/debug/metrics`` render
+the same ``_stats`` dict, so they agree by construction.  When span tracing
+is enabled, every finished Operation also lands in the trace ring under its
+op name (the ``conn.flush`` / ``gate.client_pkt`` rows in a Perfetto view).
+"""
 
 from __future__ import annotations
 
@@ -7,12 +15,21 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..telemetry import register_collector
+from ..telemetry.metrics import Histogram, Sample
+from ..telemetry import trace as _trace
+
+
+def _new_hist() -> Histogram:
+    return Histogram("opmon")  # standalone: always records (opmon is on)
+
 
 @dataclass
 class _OpStat:
     count: int = 0
     total: float = 0.0
     peak: float = 0.0
+    hist: Histogram = field(default_factory=_new_hist)
 
 
 _lock = threading.Lock()
@@ -20,19 +37,37 @@ _stats: dict[str, _OpStat] = {}
 
 
 class Operation:
-    __slots__ = ("name", "t0")
+    """Times one named operation.  Context-manager use is canonical::
 
-    def __init__(self, name: str):
+        with opmon.Operation("gate.client_pkt", 0.1, log):
+            ...
+
+    ``warn_threshold``/``logger`` given at construction apply on
+    ``__exit__``; explicit ``finish(...)`` arguments override them."""
+
+    __slots__ = ("name", "t0", "_tt0", "_warn", "_logger")
+
+    def __init__(self, name: str, warn_threshold: float = 0.0, logger=None):
         self.name = name
+        self._warn = warn_threshold
+        self._logger = logger
         self.t0 = time.perf_counter()
+        self._tt0 = _trace.t()
 
-    def finish(self, warn_threshold: float = 0.0, logger=None):
+    def finish(self, warn_threshold: float | None = None, logger=None):
         dt = time.perf_counter() - self.t0
+        if self._tt0:  # skip ops that started before tracing was enabled
+            _trace.lap(self.name, self._tt0)
         with _lock:
             st = _stats.setdefault(self.name, _OpStat())
             st.count += 1
             st.total += dt
             st.peak = max(st.peak, dt)
+            st.hist.observe(dt)
+        if warn_threshold is None:
+            warn_threshold = self._warn
+        if logger is None:
+            logger = self._logger
         if warn_threshold and dt > warn_threshold and logger is not None:
             logger.warning("op %s took %.1f ms (> %.1f ms)",
                            self.name, dt * 1e3, warn_threshold * 1e3)
@@ -56,6 +91,8 @@ def dump() -> dict[str, dict]:
                 "count": st.count,
                 "avg_ms": (st.total / st.count * 1e3) if st.count else 0.0,
                 "max_ms": st.peak * 1e3,
+                "p50_ms": st.hist.quantile(0.5) * 1e3,
+                "p99_ms": st.hist.quantile(0.99) * 1e3,
             }
             for name, st in _stats.items()
         }
@@ -64,6 +101,33 @@ def dump() -> dict[str, dict]:
 def reset():
     with _lock:
         _stats.clear()
+
+
+def _telemetry_collect():
+    """Registry collector: the op table under ``opmon.*`` dotted names,
+    one labeled sample set per op -- sourced from the same ``_stats`` dict
+    as ``dump()``, so /debug/opmon and /debug/metrics always agree."""
+    with _lock:
+        items = [(name, st.count, st.total, st.peak,
+                  st.hist.quantile(0.5), st.hist.quantile(0.99))
+                 for name, st in sorted(_stats.items())]
+    out = []
+    for name, count, total, peak, p50, p99 in items:
+        lbl = {"op": name}
+        out.append(Sample("opmon.count", "counter", count, lbl,
+                          "operations finished"))
+        out.append(Sample("opmon.total_seconds", "counter", total, lbl,
+                          "cumulative operation time"))
+        out.append(Sample("opmon.peak_seconds", "gauge", peak, lbl,
+                          "slowest single operation"))
+        out.append(Sample("opmon.p50_seconds", "gauge", p50, lbl,
+                          "median operation time (pow2 bucket bound)"))
+        out.append(Sample("opmon.p99_seconds", "gauge", p99, lbl,
+                          "p99 operation time (pow2 bucket bound)"))
+    return out
+
+
+register_collector(_telemetry_collect)
 
 
 _dump_thread: threading.Thread | None = None
@@ -99,7 +163,7 @@ def start_periodic_dump(interval: float) -> None:
                     continue
                 lines = [
                     f"  {name:32s} x{st['count']:<8d} avg {st['avg_ms']:8.2f} ms"
-                    f"  max {st['max_ms']:8.2f} ms"
+                    f"  p99 {st['p99_ms']:8.2f} ms  max {st['max_ms']:8.2f} ms"
                     for name, st in sorted(table.items())
                 ]
                 mod_log.info("opmon:\n%s", "\n".join(lines))

@@ -30,7 +30,13 @@ JAX_PLATFORMS=cpu python scripts/delta_smoke.py || fail=1
 echo "== faults smoke =="
 JAX_PLATFORMS=cpu python scripts/faults_smoke.py || fail=1
 
-# 5. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 5. telemetry smoke (CPU backend: tick a traced runtime, scrape
+#    /debug/metrics and /debug/trace, validate the Perfetto JSON --
+#    docs/observability.md)
+echo "== telemetry smoke =="
+JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py || fail=1
+
+# 6. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -4,6 +4,8 @@ binutil's pprof HTTP surface)."""
 import json
 import urllib.request
 
+from goworld_tpu import telemetry
+from goworld_tpu.telemetry import trace as gwtrace
 from goworld_tpu.utils import binutil, gwvar, opmon
 
 
@@ -58,3 +60,53 @@ def test_debug_http_endpoints():
             assert e.code == 404
     finally:
         srv.shutdown()
+
+
+def test_debug_metrics_and_trace_endpoints():
+    """/debug/metrics serves Prometheus text 0.0.4 (even though only the
+    collectors have data); /debug/trace serves Perfetto-loadable JSON with
+    ?ticks=N windowing and a 400 on a garbage param."""
+    opmon.reset()
+    opmon.start_operation("unit_test_op").finish()
+    telemetry.enable()
+    try:
+        gwtrace.reset()
+        gwtrace.mark_tick(1)
+        with gwtrace.span("tick.aoi"):
+            pass
+        gwtrace.mark_tick(2)
+        with gwtrace.span("tick.sync"):
+            pass
+        srv = binutil.setup_http_server(0)
+        try:
+            port = srv.server_address[1]
+            url = f"http://127.0.0.1:{port}"
+
+            with urllib.request.urlopen(f"{url}/debug/metrics", timeout=5) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4")
+                text = r.read().decode()
+            assert 'gw_opmon_count_total{op="unit_test_op"} 1' in text
+            # /debug/opmon and /debug/metrics agree on the same op table
+            with urllib.request.urlopen(f"{url}/debug/opmon", timeout=5) as r:
+                assert json.loads(r.read())["unit_test_op"]["count"] == 1
+
+            with urllib.request.urlopen(f"{url}/debug/trace?ticks=1",
+                                        timeout=5) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+            names = [e["name"] for e in doc["traceEvents"]]
+            assert "tick.sync" in names and "tick 2" in names
+            assert "tick 1" not in names  # windowed to the last tick
+
+            try:
+                urllib.request.urlopen(f"{url}/debug/trace?ticks=nope",
+                                       timeout=5)
+                raise AssertionError("400 expected")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        finally:
+            srv.shutdown()
+    finally:
+        telemetry.disable()

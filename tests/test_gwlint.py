@@ -17,7 +17,7 @@ import textwrap
 from pathlib import Path
 
 from goworld_tpu.analysis import coverage, determinism, dtypes, \
-    fault_seams, h2d_staging, host_sync, wire_protocol
+    fault_seams, h2d_staging, host_sync, telemetry_rule, wire_protocol
 from goworld_tpu.analysis.__main__ import main as gwlint_main
 from goworld_tpu.analysis.core import run
 
@@ -439,6 +439,82 @@ def test_fault_seam_coverage_sees_root_scripts(tmp_path):
     findings, _ = run([str(tmp_path / "goworld_tpu")], root=str(tmp_path),
                       checkers=[fault_seams.check],
                       tests_dir=str(tmp_path / "tests"))
+    assert findings == [], [f.render() for f in findings]
+
+
+# -- telemetry ---------------------------------------------------------------
+
+TELEM_USER = """\
+    from . import telemetry
+    from .telemetry import trace
+
+    def tick():
+        t0 = trace.t()
+        with trace.span("tick.documented"):
+            pass
+        trace.lap("tick.undocumented", t0)
+        telemetry.counter("tick.untested").inc()
+"""
+
+TELEM_PKG = """\
+    import jax
+
+    def export(ring):
+        import numpy as np
+        return np.asarray(ring)
+"""
+
+
+def test_telemetry_rule_flags_catalog_and_purity(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/engine.py": TELEM_USER,
+        "goworld_tpu/telemetry/trace.py": TELEM_PKG,
+        "docs/observability.md":
+            "catalog: tick.documented tick.untested\n",
+        "tests/test_t.py":
+            "assert 'tick.documented' and 'tick.undocumented'\n",
+    })
+    findings, _ = _run(tmp_path, [telemetry_rule.check],
+                       tests_dir=str(tmp_path / "tests"))
+    by_msg = sorted((f.path, f.line, f.message) for f in findings)
+    assert len(by_msg) == 4, by_msg
+    # tick.undocumented: missing from the docs catalog, at the lap() site
+    assert by_msg[0][:2] == ("goworld_tpu/engine.py",
+                             _ln(TELEM_USER, "tick.undocumented"))
+    assert "missing from docs/observability.md" in by_msg[0][2]
+    # tick.untested: documented but never referenced from tests/
+    assert by_msg[1][:2] == ("goworld_tpu/engine.py",
+                             _ln(TELEM_USER, "tick.untested"))
+    assert "never referenced from tests/" in by_msg[1][2]
+    # the telemetry package itself: module-level jax + a host-copy call
+    assert by_msg[2][:2] == ("goworld_tpu/telemetry/trace.py",
+                             _ln(TELEM_PKG, "import jax"))
+    assert "module-level jax import" in by_msg[2][2]
+    assert by_msg[3][:2] == ("goworld_tpu/telemetry/trace.py",
+                             _ln(TELEM_PKG, "np.asarray"))
+    assert "host-sync call 'asarray'" in by_msg[3][2]
+    # tick.documented -- documented and tested -- is clean
+    assert not any("tick.documented" in m for _p, _l, m in by_msg)
+
+
+def test_telemetry_rule_clean_catalog_and_skips_tests(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/engine.py":
+            "from .telemetry import trace\n"
+            "def tick():\n"
+            '    with trace.span("tick.aoi"):\n'
+            "        pass\n",
+        # span names in tests/ never draw findings (the catalog governs
+        # production emitters only)
+        "tests/test_t.py":
+            "from goworld_tpu.telemetry import trace\n"
+            "def test_x():\n"
+            '    with trace.span("tick.aoi"):\n'
+            '        trace.lap("not.cataloged", 0.0)\n',
+        "docs/observability.md": "tick.aoi\n",
+    })
+    findings, _ = _run(tmp_path, [telemetry_rule.check],
+                       tests_dir=str(tmp_path / "tests"))
     assert findings == [], [f.render() for f in findings]
 
 

@@ -1,0 +1,482 @@
+"""Unified telemetry: the observability layer never changes what it observes.
+
+The contract under test (docs/observability.md):
+
+* **bit-exactness** -- the enter/leave event stream of a sparse walk is
+  byte-identical with telemetry enabled and disabled, on every device tier
+  (single-chip, mesh, row-sharded), because spans read clocks and counters
+  only;
+* **disabled path** -- every instrument is a no-op (``t()`` returns 0.0,
+  ``span`` is a shared singleton, counters don't move);
+* **trace export** -- spans land in a bounded ring and export as Chrome
+  trace-event JSON (Perfetto-loadable): "X" spans nest, "i" tick marks,
+  ``last_ticks`` windows, timestamps ride the injected clock;
+* **exposition** -- the registry renders Prometheus text 0.0.4 (cumulative
+  pow2 buckets, ``_total`` counters, sorted labels) and stays exact under
+  concurrent mutation;
+* **agreement** -- ``opmon.dump()`` and the registry collector render the
+  same numbers, and the canonical name catalog in docs/observability.md
+  covers every name production code can emit (the ``telemetry`` gwlint
+  rule enforces the converse).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from goworld_tpu import telemetry
+from goworld_tpu.engine.aoi import AOIEngine
+from goworld_tpu.telemetry import trace
+from goworld_tpu.telemetry.metrics import (HIST_BOUNDS, Registry, Sample,
+                                           bucket_index)
+from goworld_tpu.utils import gwlog, opmon
+from test_aoi_delta import _assert_same, _drive
+
+REPO = Path(__file__).resolve().parents[1]
+
+# every metric, span, and op name production code can emit with a literal
+# (docs/observability.md catalog; the gwlint `telemetry` rule pins
+# code->docs/tests, this list pins docs->tests)
+CANONICAL_NAMES = (
+    # runtime tick phases + the whole-tick histogram
+    "tick", "tick.seconds", "tick.timers", "tick.aoi", "tick.sync",
+    "tick.post",
+    # AOI engine phase spans + engine gauges
+    "aoi.flush", "aoi.emit", "aoi.h2d", "aoi.stage", "aoi.kernel",
+    "aoi.fetch", "aoi.diff", "aoi.host_tick", "aoi.buckets",
+    "aoi.calc_level",
+    # opmon op names (components + net + storage)
+    "conn.flush", "gate.client_pkt", "game.outbox", "disp.route",
+    "storage.op",
+    # dispatchercluster link samples
+    "disp.connected", "disp.attempts", "disp.backoff_s", "disp.pending",
+    "disp.replayed", "disp.dropped",
+    # fault-injection samples
+    "faults.active", "faults.occurrences", "faults.fired",
+    # opmon bridge samples
+    "opmon.count", "opmon.total_seconds", "opmon.peak_seconds",
+    "opmon.p50_seconds", "opmon.p99_seconds",
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_after():
+    """Telemetry state is process-global; never leak it across tests."""
+    yield
+    telemetry.disable()
+
+
+# -- bit-exact parity: telemetry on vs off, per device tier ------------------
+
+
+def _walk(cap=256, ticks=8, n=180, **tpu_kwargs):
+    engines = {"cpu": AOIEngine(default_backend="cpu"),
+               "tpu": AOIEngine(default_backend="tpu", **tpu_kwargs)}
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    out, _ = _drive(engines, handles, cap, ticks, n=n)
+    _assert_same(out)
+    return out
+
+
+def _assert_on_off_identical(off, on):
+    assert len(off["tpu"]) == len(on["tpu"])
+    for t, (oe, ol) in enumerate(off["tpu"]):
+        ne, nl = on["tpu"][t]
+        np.testing.assert_array_equal(oe, ne, err_msg=f"enter tick {t}")
+        np.testing.assert_array_equal(ol, nl, err_msg=f"leave tick {t}")
+
+
+def _traced_walk(**kw):
+    """Run the walk with tracing live; return (events, span-name set)."""
+    telemetry.enable()
+    trace.reset()
+    try:
+        on = _walk(**kw)
+        names = {nm for nm, _tid, _t0, _t1 in trace.spans()}
+    finally:
+        telemetry.disable()
+    return on, names
+
+
+def test_single_chip_parity_on_vs_off():
+    """The acceptance criterion: the same sparse walk with telemetry off
+    and on yields byte-identical event streams, and the traced run
+    recorded the per-phase engine spans."""
+    off = _walk()
+    on, names = _traced_walk()
+    _assert_on_off_identical(off, on)
+    assert {"aoi.stage", "aoi.kernel", "aoi.fetch", "aoi.diff"} <= names, \
+        names
+
+
+def _mesh_devices():
+    from goworld_tpu.parallel import multichip_devices
+
+    devs = multichip_devices(8)
+    if len(devs) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return devs
+
+
+def test_mesh_parity_on_vs_off():
+    from goworld_tpu.parallel import SpaceMesh
+
+    devs = _mesh_devices()
+    off = _walk(mesh=SpaceMesh(devs))
+    on, names = _traced_walk(mesh=SpaceMesh(devs))
+    _assert_on_off_identical(off, on)
+    assert {"aoi.stage", "aoi.kernel", "aoi.fetch", "aoi.diff"} <= names, \
+        names
+
+
+def test_rowshard_parity_on_vs_off():
+    from goworld_tpu.parallel import SpaceMesh
+
+    devs = _mesh_devices()
+    kw = dict(cap=2048, ticks=5, n=300, rowshard_min_capacity=2048)
+    off = _walk(mesh=SpaceMesh(devs), **kw)
+    on, names = _traced_walk(mesh=SpaceMesh(devs), **kw)
+    _assert_on_off_identical(off, on)
+    assert {"aoi.stage", "aoi.kernel", "aoi.fetch", "aoi.diff"} <= names, \
+        names
+
+
+# -- disabled path -----------------------------------------------------------
+
+
+def test_disabled_instruments_are_noops():
+    telemetry.disable()
+    assert trace.t() == 0.0
+    assert trace.lap("tick", 0.0) == 0.0
+    # span() hands out the shared no-op singleton, not a fresh object
+    assert trace.span("tick.aoi") is trace.span("tick.sync")
+    assert trace.spans() == []
+    reg = Registry(enabled=False)
+    c = reg.counter("aoi.h2d_bytes")
+    c.inc(5)
+    g = reg.gauge("aoi.buckets")
+    g.set(3)
+    h = reg.histogram("tick.seconds")
+    h.observe(1.0)
+    assert (c.value, g.value, h.count) == (0.0, 0.0, 0)
+
+
+def test_gw_telemetry_env_enables_at_import():
+    code = ("from goworld_tpu import telemetry\n"
+            "from goworld_tpu.telemetry import trace\n"
+            "print(telemetry.enabled(), trace.enabled())\n")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("GW_TELEMETRY", None)
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.split() == ["False", "False"]
+    r = subprocess.run([sys.executable, "-c", code],
+                       env={**env, "GW_TELEMETRY": "1"}, cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.split() == ["True", "True"]
+
+
+# -- trace export ------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_chrome_trace_schema_nesting_and_windowing():
+    clk = _Clock()
+    telemetry.enable(clock=clk)
+    trace.reset()
+    for n in (1, 2):
+        clk.advance(1.0)
+        trace.mark_tick(n)
+        t0 = trace.t()
+        with trace.span("tick.aoi"):
+            clk.advance(0.002)
+        trace.lap("tick", t0)
+    doc = trace.export_chrome_trace()
+
+    evs = doc["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta[0]["args"]["name"] == "goworld_tpu"
+    xs = [e for e in evs if e["ph"] == "X"]
+    marks = [e for e in evs if e["ph"] == "i"]
+    assert [e["name"] for e in marks] == ["tick 1", "tick 2"]
+    assert all(e["pid"] == os.getpid() for e in xs)
+    assert all(e["tid"] == threading.get_ident() for e in xs)
+    # microseconds relative to the oldest stamp (the first tick mark)
+    aoi1, tick1 = xs[0], xs[1]
+    assert (aoi1["name"], tick1["name"]) == ("tick.aoi", "tick")
+    assert aoi1["ts"] == pytest.approx(0.0)
+    assert aoi1["dur"] == pytest.approx(2000.0)
+    aoi2 = xs[2]
+    assert aoi2["ts"] == pytest.approx(1.002e6)
+    # spans nest: each tick.aoi interval lies inside its tick span
+    for aoi, tick in ((xs[0], xs[1]), (xs[2], xs[3])):
+        assert tick["ts"] <= aoi["ts"]
+        assert aoi["ts"] + aoi["dur"] <= tick["ts"] + tick["dur"]
+
+    # ?ticks=1 windows to the spans of the most recent tick
+    win = trace.export_chrome_trace(last_ticks=1)
+    wx = [e for e in win["traceEvents"] if e["ph"] == "X"]
+    wm = [e for e in win["traceEvents"] if e["ph"] == "i"]
+    assert [e["name"] for e in wm] == ["tick 2"]
+    assert [e["name"] for e in wx] == ["tick.aoi", "tick"]
+
+
+def test_trace_ring_is_bounded():
+    telemetry.enable(ring=4)
+    trace.reset()
+    for _ in range(10):
+        trace.lap("tick", trace.t())
+    assert len(trace.spans()) == 4
+
+
+def test_chrome_trace_file_export(tmp_path):
+    telemetry.enable(clock=_Clock())
+    trace.reset()
+    with trace.span("tick.aoi"):
+        pass
+    path = tmp_path / "trace.json"
+    doc = trace.export_chrome_trace(path=str(path))
+    assert json.loads(path.read_text()) == doc
+    assert doc["displayTimeUnit"] == "ms"
+
+
+def test_runtime_tick_records_spans_on_injected_clock():
+    """Runtime(telemetry_on=True) routes span stamps through its ``now``
+    seam: span durations are exactly what the fake clock says, and the
+    whole-tick histogram observes them."""
+    from goworld_tpu.engine.runtime import Runtime
+
+    clk = _Clock()
+    hist = telemetry.registry().histogram("tick.seconds")
+    count0 = hist.count
+    rt = Runtime(now=clk, telemetry_on=True)
+    trace.reset()
+    rt.tick()
+    spans = {nm: (t0, t1) for nm, _tid, t0, t1 in trace.spans()}
+    assert {"tick", "tick.timers", "tick.aoi", "tick.sync",
+            "tick.post"} <= set(spans)
+    t0, t1 = spans["tick"]
+    assert (t0, t1) == (clk.t, clk.t)  # fake clock never advanced
+    assert hist.count == count0 + 1
+
+
+# -- metrics registry --------------------------------------------------------
+
+
+def test_bucket_index_pow2_boundaries():
+    assert bucket_index(0.0) == 0
+    assert bucket_index(HIST_BOUNDS[0]) == 0
+    for i, b in enumerate(HIST_BOUNDS):
+        assert bucket_index(b) == i, b  # bounds are inclusive upper edges
+        if i:
+            assert bucket_index(b * 0.75) == i, b
+    assert bucket_index(HIST_BOUNDS[-1] * 2) == len(HIST_BOUNDS)
+
+
+def test_prometheus_text_format():
+    reg = Registry(enabled=True)
+    reg.counter("aoi.h2d_bytes", "bytes shipped").inc(512)
+    reg.gauge("aoi.buckets").set(2)
+    h = reg.histogram("tick.seconds", "tick wall time")
+    for v in (1.5e-6, 0.25, 100.0):  # one per region: low, mid, overflow
+        h.observe(v)
+    reg.register_collector(lambda: [
+        Sample("disp.pending", "gauge", 3.0, {"tag": "game1", "disp": "0"}),
+        Sample("disp.replayed", "counter", 7.0, {"disp": "0"}),
+    ])
+    text = reg.render_prometheus()
+    assert text.endswith("\n")
+    lines = text.splitlines()
+
+    assert "# TYPE gw_aoi_h2d_bytes_total counter" in lines
+    assert "gw_aoi_h2d_bytes_total 512" in lines
+    assert "# TYPE gw_aoi_buckets gauge" in lines
+    assert "gw_aoi_buckets 2" in lines
+
+    # histogram: one line per pow2 bound plus +Inf, cumulative counts
+    bucket_lines = [ln for ln in lines
+                    if ln.startswith("gw_tick_seconds_bucket")]
+    assert len(bucket_lines) == len(HIST_BOUNDS) + 1
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in bucket_lines]
+    assert counts == sorted(counts) and counts[-1] == 3
+    assert bucket_lines[-1].startswith('gw_tick_seconds_bucket{le="+Inf"}')
+    assert "gw_tick_seconds_count 3" in lines
+    assert any(ln.startswith("gw_tick_seconds_sum ") for ln in lines)
+
+    # collector samples: sorted labels, counters suffixed _total
+    assert 'gw_disp_pending{disp="0",tag="game1"} 3' in lines
+    assert 'gw_disp_replayed_total{disp="0"} 7' in lines
+
+
+def test_registry_rejects_kind_conflicts():
+    reg = Registry(enabled=True)
+    reg.counter("aoi.h2d_bytes")
+    with pytest.raises(TypeError):
+        reg.gauge("aoi.h2d_bytes")
+    # same-kind re-registration returns the same instrument
+    assert reg.counter("aoi.h2d_bytes") is reg.counter("aoi.h2d_bytes")
+
+
+def test_registry_thread_safety():
+    reg = Registry(enabled=True)
+    c = reg.counter("aoi.h2d_bytes")
+    h = reg.histogram("tick.seconds")
+    n_threads, n_iter = 8, 2000
+
+    def work():
+        for _ in range(n_iter):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * n_iter
+    assert c.value == total
+    assert h.count == total
+    assert sum(h.snapshot()["buckets"]) == total
+
+
+def test_weak_collectors_die_with_their_owner():
+    class Owner:
+        def collect(self):
+            return [Sample("aoi.buckets", "gauge", 1.0)]
+
+    reg = Registry(enabled=True)
+    o = Owner()
+    reg.register_collector(o.collect, weak=True)
+    assert reg.snapshot().get("aoi.buckets") == 1.0
+    del o
+    assert "aoi.buckets" not in reg.snapshot()
+
+
+# -- opmon bridge ------------------------------------------------------------
+
+
+def test_opmon_quantiles_and_registry_agreement():
+    """/debug/opmon and /debug/metrics render the same _stats dict: the
+    dump's p50/p99 are exactly the registry collector's, scaled to ms."""
+    opmon.reset()
+    for _ in range(20):
+        with opmon.Operation("storage.op"):
+            pass
+    d = opmon.dump()["storage.op"]
+    assert d["count"] == 20
+    assert 0 < d["p50_ms"] <= d["p99_ms"] <= d["max_ms"] * 64  # pow2-coarse
+    snap = telemetry.snapshot()
+    assert snap['opmon.count{op="storage.op"}'] == 20
+    assert snap['opmon.p50_seconds{op="storage.op"}'] * 1e3 == d["p50_ms"]
+    assert snap['opmon.p99_seconds{op="storage.op"}'] * 1e3 == d["p99_ms"]
+    assert snap['opmon.peak_seconds{op="storage.op"}'] * 1e3 == d["max_ms"]
+
+
+def test_opmon_operations_land_in_trace_ring():
+    telemetry.enable()
+    trace.reset()
+    with opmon.Operation("game.outbox"):
+        pass
+    assert "game.outbox" in [nm for nm, *_ in trace.spans()]
+
+
+def test_faults_collector_reports_plan_state():
+    from goworld_tpu import faults
+
+    faults.clear()
+    snap = telemetry.snapshot()
+    assert snap["faults.active"] == 0.0
+    faults.install("seed=3;conn.flush:reset@1")
+    try:
+        with pytest.raises(ConnectionResetError):
+            faults.check("conn.flush")
+        snap = telemetry.snapshot()
+        assert snap["faults.active"] == 1.0
+        assert snap['faults.occurrences{seam="conn.flush"}'] == 1.0
+        assert snap['faults.fired{seam="conn.flush"}'] == 1.0
+    finally:
+        faults.clear()
+
+
+def test_dispatchercluster_status_in_registry():
+    from goworld_tpu.dispatchercluster import DispatcherCluster
+
+    # two dispatcher addrs, maintain threads never started: both links
+    # report down through the registry under per-cluster labels
+    dc = DispatcherCluster([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                           on_packet=lambda i, pkt: None,
+                           register=lambda conn: None, tag="game1")
+    cid = dc._telemetry_id
+    snap = telemetry.snapshot()
+    for i in range(2):
+        key = ('disp.connected{cluster="%d",disp="%d",tag="game1"}'
+               % (cid, i))
+        assert snap[key] == 0.0
+    dc.stop()
+
+
+# -- structured logs ---------------------------------------------------------
+
+
+def test_gwlog_json_lines_keeps_ready_tag(tmp_path):
+    logf = tmp_path / "game.log"
+    gwlog.setup("info", str(logf), json_lines=True)
+    try:
+        gwlog.announce_ready("game1", "game")
+    finally:
+        gwlog.setup("info")
+    line = logf.read_text().strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert sorted(rec) == ["component", "level", "msg", "ts"]
+    assert rec["component"] == "gw.game1"
+    assert rec["level"] == "INFO"
+    # the supervisor start barrier still greps the raw line
+    assert gwlog.READY_TAG in rec["msg"] and gwlog.READY_TAG in line
+
+
+def test_gwlog_json_env_gate(tmp_path, monkeypatch):
+    monkeypatch.setenv("GW_LOG_JSON", "1")
+    logf = tmp_path / "env.log"
+    gwlog.setup("info", str(logf))  # json_lines=None -> GW_LOG_JSON
+    try:
+        logging.getLogger("gw.gate1").info("hello")
+    finally:
+        gwlog.setup("info")
+    rec = json.loads(logf.read_text().strip().splitlines()[-1])
+    assert (rec["component"], rec["msg"]) == ("gw.gate1", "hello")
+
+
+# -- the name catalog --------------------------------------------------------
+
+
+def test_canonical_names_are_documented():
+    """docs/observability.md lists every canonical name with dotted-word
+    precision (matching the gwlint `telemetry` rule's notion of
+    'documented'): 'tick' may not ride on 'tick.seconds'."""
+    docs = (REPO / "docs" / "observability.md").read_text()
+    missing = [nm for nm in CANONICAL_NAMES
+               if not re.search(r"(?<![\w.])" + re.escape(nm) + r"(?![\w.])",
+                                docs)]
+    assert missing == [], missing
