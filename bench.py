@@ -862,18 +862,26 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
     qx_meas = rng2.integers(-QMAX, QMAX + 1, (need, s, cap)).astype(np.int8)
     qz_meas = rng2.integers(-QMAX, QMAX + 1, (need, s, cap)).astype(np.int8)
 
+    # measured reps + device-only drain share one budgeted staging source
+    # (_stage_source / BENCH_DEVICE_STAGE_BUDGET_MB): the old per-rep bare
+    # stage_q jnp.asarray calls re-staged every chunk of the giant-C
+    # configs each rep on top of the carried words and crashed BENCH_r05
+    # with RESOURCE_EXHAUSTED; grid mode stages 4 arrays per chunk
+    get_q, stage_mode = _stage_source(
+        lambda ci: stage_q(qx_meas[ci * chunk:(ci + 1) * chunk],
+                           qz_meas[ci * chunk:(ci + 1) * chunk]),
+        n_chunks, (4 if cfg.kernel == "grid" else 2) * chunk * s * cap)
+
     def one_rep():
         stats_all = []
         t0 = time.perf_counter()
         carry = wcarry
         pending = None
-        nxt = stage_q(qx_meas[:chunk], qz_meas[:chunk])
+        nxt = get_q(0)
         for ci in range(n_chunks):
             carry, st = run(carry, *nxt)
             if ci + 1 < n_chunks:
-                lo = (ci + 1) * chunk
-                nxt = stage_q(qx_meas[lo:lo + chunk],
-                              qz_meas[lo:lo + chunk])
+                nxt = get_q(ci + 1)  # overlap H2D; drop previous buffers
             st.copy_to_host_async()
             if pending is not None:
                 stats_all.append(np.asarray(pending))
@@ -893,14 +901,8 @@ def bench_tpu_device_cadence(cfg, qx, qz, xs, zs):
     # device-only drain (no stats fetch): isolates the on-device pipeline.
     # MARGINAL per tick via long-minus-half drains (see bench_tpu: fixed
     # dispatch RPC cost would otherwise be billed to the chip), each length
-    # best-of-N.
-    # inputs staged within the device-memory budget (see bench_tpu.drain /
-    # _stage_source: BENCH_r05's pre-stage-all of the giant-C configs
-    # crashed RESOURCE_EXHAUSTED); grid mode stages 4 arrays per chunk
-    get_q, stage_mode = _stage_source(
-        lambda ci: stage_q(qx_meas[ci * chunk:(ci + 1) * chunk],
-                           qz_meas[ci * chunk:(ci + 1) * chunk]),
-        n_chunks, (4 if cfg.kernel == "grid" else 2) * chunk * s * cap)
+    # best-of-N.  Inputs ride the same budgeted staging source as the
+    # measured reps above.
 
     def drain(n):
         t0 = time.perf_counter()
@@ -1118,7 +1120,8 @@ def _timed(fn):
 
 
 def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
-                 movers_frac=None, delta_staging=True):
+                 movers_frac=None, delta_staging=True, flush_sched=True,
+                 cap_mix=False):
     """Engine-level number: ``Runtime.tick`` end-to-end.
 
     Movement drive:
@@ -1149,6 +1152,17 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     the same line recorded with ``delta_staging=False`` (full restage
     every tick) is the A/B baseline; compare their ``aoi_stage_ms`` and
     ``aoi_h2d_bytes_per_tick``.
+
+    ``flush_sched`` toggles the split-phase flush scheduler (docs/perf.md
+    issue/harvest model): True dispatches every bucket before the first
+    blocking fetch, False forces the sequential baseline (each bucket
+    dispatches AND harvests before the next starts).  ``cap_mix=True``
+    pre-sizes every other space to twice the default capacity, so the
+    engine holds >= 2 buckets and the scheduler has cross-bucket work to
+    overlap -- the A/B pair to compare is scheduler-on ``span_tick_ms``
+    vs. the sequential run's per-bucket kernel+fetch+emit sum, with
+    bit-identical ``parity_checksum`` (a CRC fold over every delivered
+    enter/leave pair array, in delivery order).
     """
     import jax
 
@@ -1175,17 +1189,40 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
             pass
 
     rt = Runtime(aoi_backend=backend, aoi_pipeline=pipeline,
-                 aoi_delta_staging=delta_staging)
+                 aoi_delta_staging=delta_staging,
+                 aoi_flush_sched=flush_sched)
     rt.entities.register(BenchScene)
     rt.entities.register(BenchMob)
     rt.entities.register(BenchWatcher)
+    # parity checksum: CRC-fold every delivered enter/leave pair array in
+    # delivery order -- bit-identical between flush_sched on and off is
+    # the scheduler's correctness artifact (events are consumed inside
+    # rt.tick, so the fold rides the take_events seam)
+    import zlib
+
+    _crc = {"v": 0}
+    _orig_take = rt.aoi.take_events
+
+    def _folding_take(h):
+        ev = _orig_take(h)
+        _crc["v"] = zlib.crc32(np.ascontiguousarray(ev[0]).tobytes(),
+                               _crc["v"])
+        _crc["v"] = zlib.crc32(np.ascontiguousarray(ev[1]).tobytes(),
+                               _crc["v"])
+        return ev
+
+    rt.aoi.take_events = _folding_take
     rng = np.random.default_rng(3)
     per = cfg.n_active // cfg.s
     ents = []
     spaces = []
     for _si in range(cfg.s):
         sp = rt.entities.create_space("BenchScene", kind=1)
-        sp.enable_aoi(cfg.radius)
+        # cap_mix: every other space pre-sized to 2x the engine's default
+        # bucket capacity -> >= 2 buckets, cross-bucket overlap to measure
+        sp.enable_aoi(cfg.radius,
+                      capacity=(2 * rt.aoi.tpu_min_capacity
+                                if cap_mix and _si % 2 else None))
         spaces.append(sp)
         for i in range(per):
             ents.append(rt.entities.create(
@@ -1323,7 +1360,10 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     telemetry.disable()
     kind = backend + ("+pipeline" if pipeline else "")
     drive = "bulk move_entities" if bulk else "per-entity set_position"
-    if movers_frac is not None:
+    if cap_mix:
+        config = "engine_sched"
+        kind += "+sched" if flush_sched else "+seq"
+    elif movers_frac is not None:
         config = "engine_sparse"
         kind += "+delta" if delta_staging else "+fullstage"
     elif watchers == 0:
@@ -1379,11 +1419,21 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
         ph: round(span_s.get(nm, 0.0) / total_ticks * 1e3, 3)
         for ph, nm in (("stage", "aoi.stage"), ("kernel", "aoi.kernel"),
                        ("diff", "aoi.diff"), ("fetch", "aoi.fetch"),
-                       ("emit", "aoi.emit"))
+                       ("emit", "aoi.emit"),
+                       ("dispatch", "aoi.dispatch"),
+                       ("harvest", "aoi.harvest"))
     }
     if span_s.get("tick"):
         out["span_tick_ms"] = round(
             span_s["tick"] / total_ticks * 1e3, 2)
+    # split-phase scheduler A/B bookkeeping (docs/perf.md): the checksum
+    # folds every delivered enter/leave pair in delivery order, so a
+    # scheduler-on and scheduler-off run of the same config must print the
+    # same hex or the overlap changed observable event order
+    out["flush_sched"] = flush_sched
+    out["parity_checksum"] = f"{_crc['v']:08x}"
+    if cap_mix:
+        out["n_buckets"] = len(rt.aoi._buckets)
     stats1 = stats_snapshot()
     if stats1:
         # H2D attribution (delta staging): bytes actually shipped per tick
@@ -1610,6 +1660,16 @@ def main():
                 # restage -- compare aoi_stage_ms and aoi_h2d_bytes_per_tick
                 emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True,
                                   movers_frac=0.1))
+                # split-phase flush scheduler A/B (docs/perf.md): cap_mix
+                # splits the spaces across two bucket capacities so the
+                # scheduler has >=2 device buckets to overlap; same walk with
+                # issue-all-then-harvest on, then forced per-bucket
+                # sequential.  Compare span_tick_ms and phase_ms
+                # dispatch/harvest -- parity_checksum must be bit-identical
+                emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True,
+                                  cap_mix=True, flush_sched=True))
+                emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True,
+                                  cap_mix=True, flush_sched=False))
                 out = bench_engine(cfg, "tpu", pipeline=True, bulk=True,
                                    movers_frac=0.1, delta_staging=False)
             else:
@@ -1673,6 +1733,9 @@ def main():
                          ("aoi_calc_ms", "calc_ms"),
                          ("aoi_h2d_bytes_per_tick", "h2d_B"),
                          ("aoi_delta_hit_rate", "delta_hit"),
+                         ("flush_sched", "sched"),
+                         ("parity_checksum", "crc"),
+                         ("span_tick_ms", "span_ms"),
                          ("host_other_ms", "host_ms")):
             if src in o:
                 rec[dst] = o[src]

@@ -1,6 +1,6 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Eight checkers, each
+Run as ``python -m goworld_tpu.analysis <paths>``.  Nine checkers, each
 an AST pass over the tree (stdlib-only -- no jax import needed):
 
 ===================  =====================================================
@@ -17,6 +17,8 @@ fault-seam-coverage  declared fault seams are checked in package code and
                      exercised from tests/
 telemetry            every metric/span name is documented + tested; the
                      telemetry package never syncs the device
+flush-phase          no host-sync call reachable from a bucket dispatch()
+                     body (the split-phase scheduler's overlap contract)
 ===================  =====================================================
 
 See docs/static-analysis.md for the suppression story.
@@ -24,8 +26,8 @@ See docs/static-analysis.md for the suppression story.
 
 from __future__ import annotations
 
-from . import (coverage, determinism, dtypes, fault_seams, h2d_staging,
-               host_sync, telemetry_rule, wire_protocol)
+from . import (coverage, determinism, dtypes, fault_seams, flush_phase,
+               h2d_staging, host_sync, telemetry_rule, wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
 CHECKERS = [
@@ -37,6 +39,7 @@ CHECKERS = [
     h2d_staging.check,
     fault_seams.check,
     telemetry_rule.check,
+    flush_phase.check,
 ]
 
 __all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]

@@ -1,0 +1,155 @@
+"""flush-phase: dispatch() must never block on a device value.
+
+The split-phase flush scheduler (docs/perf.md) only overlaps H2D, kernel
+dispatch, D2H and host decode across AOI buckets because ``dispatch()``
+is pure enqueue: every bucket's dispatch runs before the FIRST blocking
+fetch, so one stray ``np.asarray`` / ``.item()`` / ``block_until_ready``
+inside a dispatch body serializes the whole tick back to
+flush-per-bucket -- silently, with nothing crashing and the scheduler
+spans still printing.  This rule walks the static call graph from each
+bucket tier's ``dispatch()`` (``self.X`` resolved through the class, its
+bases -- ``_Bucket`` lives in engine/aoi.py -- and module functions) and
+flags any host-sync call it can reach.
+
+Boundaries are explicit: a call line or callee ``def`` line carrying
+``# gwlint: allow[flush-phase] -- <why>`` stops the traversal there (the
+idiom for the re-entrant harvest guard and the fault-recovery paths,
+where the device is gone and host sync is the point).
+
+Scope: the bucket modules (engine/aoi.py, engine/aoi_mesh.py,
+engine/aoi_rowshard.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, SourceFile, call_name
+from .host_sync import _SYNC_ATTRS, _SYNC_CALLS
+
+RULE = "flush-phase"
+
+SCOPE = ("engine/aoi.py", "engine/aoi_mesh.py", "engine/aoi_rowshard.py")
+
+
+def _sync_msg(node: ast.Call) -> str | None:
+    """The host_sync detection, verbatim (one taxonomy, two rules)."""
+    name = call_name(node)
+    if name in _SYNC_CALLS:
+        return _SYNC_CALLS[name]
+    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTRS:
+        verb = ("forces a device sync" if node.func.attr == "block_until_ready"
+                else "is a scalar D2H fetch")
+        return f".{node.func.attr}() {verb}"
+    if name in ("float", "int") and len(node.args) == 1 \
+            and not node.keywords \
+            and not isinstance(node.args[0], ast.Constant):
+        return f"{name}() on a possibly-device value is a scalar D2H fetch"
+    return None
+
+
+class _Graph:
+    """Method/function tables over every scoped file, for self.X lookup."""
+
+    def __init__(self, files: list[SourceFile]):
+        # class name -> (base names, {method name: (node, sf)})
+        self.classes: dict[str, tuple[list[str], dict]] = {}
+        # bare function name -> (node, sf); per file, module level only
+        self.mod_funcs: dict[str, dict] = {}
+        for sf in files:
+            funcs = self.mod_funcs.setdefault(sf.rel, {})
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    bases = [b.id for b in node.bases
+                             if isinstance(b, ast.Name)]
+                    methods = {
+                        m.name: (m, sf) for m in node.body
+                        if isinstance(m, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+                    self.classes[node.name] = (bases, methods)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    funcs[node.name] = (node, sf)
+
+    def resolve_method(self, cls: str, name: str):
+        """(node, sf) for cls.name, searching bases depth-first by name --
+        mesh/rowshard import their bases from engine/aoi.py, so bare base
+        names resolve across files."""
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c in seen or c not in self.classes:
+                continue
+            seen.add(c)
+            bases, methods = self.classes[c]
+            if name in methods:
+                return methods[name]
+            stack.extend(bases)
+        return None
+
+    def resolve_function(self, rel: str, name: str):
+        hit = self.mod_funcs.get(rel, {}).get(name)
+        if hit is not None:
+            return hit
+        for funcs in self.mod_funcs.values():
+            if name in funcs:
+                return funcs[name]
+        return None
+
+
+def _has_allow(sf: SourceFile, line: int) -> bool:
+    rules = sf.allow.get(line)
+    return bool(rules) and (RULE in rules or "*" in rules)
+
+
+def check(ctx: Context):
+    files = ctx.files_matching(*SCOPE)
+    graph = _Graph(files)
+    for sf in files:
+        for cls in sf.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            entry = graph.classes.get(cls.name, ([], {}))[1].get("dispatch")
+            if entry is None or entry[1] is not sf:
+                continue  # inherited default (host-only tiers) is inline-ok
+            yield from _walk(graph, cls.name, "dispatch", *entry)
+
+
+def _walk(graph: _Graph, cls: str, entry_name: str, entry_node, entry_sf):
+    # BFS over (function node, its file, display path from dispatch)
+    visited: set[tuple[str, int]] = set()
+    queue = [(entry_node, entry_sf, f"{cls}.{entry_name}")]
+    while queue:
+        fn, sf, path = queue.pop(0)
+        key = (sf.rel, fn.lineno)
+        if key in visited:
+            continue
+        visited.add(key)
+        if _has_allow(sf, fn.lineno):
+            continue  # whole callee is a declared boundary
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = _sync_msg(node)
+            if msg is not None:
+                yield Finding(
+                    RULE, sf.rel, node.lineno, node.col_offset,
+                    f"{msg}, reachable from {path} -- dispatch() must be "
+                    "pure enqueue (docs/perf.md: the scheduler overlap "
+                    "dies at the first blocking fetch); move it into "
+                    "harvest() or mark the boundary "
+                    "'# gwlint: allow[flush-phase] -- <why>'")
+                continue
+            if _has_allow(sf, node.lineno):
+                continue  # declared boundary at the call site
+            callee = None
+            if isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                callee = graph.resolve_method(cls, node.func.attr)
+                label = f"self.{node.func.attr}"
+            elif isinstance(node.func, ast.Name):
+                callee = graph.resolve_function(sf.rel, node.func.id)
+                label = node.func.id
+            if callee is not None:
+                queue.append((callee[0], callee[1], f"{path} -> {label}"))

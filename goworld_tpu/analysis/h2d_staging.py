@@ -10,9 +10,11 @@ every full-array staged-input H2D into the ``_h2d`` / ``_stage_inputs`` /
 ``_stage_xz`` seam precisely so this is auditable in one place; this rule
 keeps it there.
 
-Flagged: inside any function named ``flush`` (or a ``_flush*`` helper the
-flush wrapper delegates to -- the fault-tolerance refactor moved flush
-bodies into ``_flush_device``), an upload call
+Flagged: inside any function named ``flush`` or ``dispatch`` (or a
+``_flush*`` / ``_dispatch*`` helper the wrappers delegate to -- the
+fault-tolerance refactor moved flush bodies into ``_flush_device``, and
+the split-phase scheduler renamed them ``_dispatch_device``), an upload
+call
 (``jnp.asarray`` / ``jnp.array`` / ``jax.device_put`` / ``*.device_put``
 / the local ``put`` alias) whose argument is a host shadow -- a
 ``self._h*`` attribute, a slice/index of one, or a local name assigned
@@ -62,8 +64,9 @@ def check(ctx: Context):
     for sf in ctx.files_matching(*SCOPE):
         for fn in ast.walk(sf.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    or not (fn.name == "flush"
-                            or fn.name.startswith("_flush")):
+                    or not (fn.name in ("flush", "dispatch")
+                            or fn.name.startswith("_flush")
+                            or fn.name.startswith("_dispatch")):
                 continue
             # local names rebound from a shadow array count as shadows too
             shadow_locals: set[str] = set()

@@ -96,6 +96,21 @@ def _device_fault(e: BaseException) -> bool:
     return "RESOURCE_EXHAUSTED" in msg or "ALLOCATION" in msg.upper()
 
 
+def _kernelish_fault(e: BaseException) -> bool:
+    """Sub-classify a device fault that surfaced at HARVEST time.  Under
+    async dispatch a kernel failure only materializes at the blocking
+    fetch, where the seam cannot tell it from a transfer fault -- so the
+    calculator-demotion decision keys off the exception itself: an
+    injected KernelFailure (or a non-OOM XLA runtime error) demotes the
+    calc chain one level, a DeviceOOM/RESOURCE_EXHAUSTED only rebuilds."""
+    if isinstance(e, faults.KernelFailure):
+        return True
+    if isinstance(e, faults.InjectedFault):
+        return False
+    return type(e).__name__ in ("XlaRuntimeError", "JaxRuntimeError") \
+        and "RESOURCE_EXHAUSTED" not in str(e)
+
+
 def _packed_predicate(x, z, r, act, block: int = 2048) -> np.ndarray:  # gwlint: allow[host-sync] -- pure host numpy on the durable copies (recovery path), never device values
     """Host recomputation of one slot's packed interest words [C, W] --
     bit-exact with every device backend (all evaluate the same f32
@@ -287,12 +302,18 @@ class AOIEngine:
                  oracle_algorithm: str = "sweep", mesh=None,
                  pipeline: bool = False, delta_staging: bool = True,
                  tpu_min_capacity: int = 4096,
-                 rowshard_min_capacity: int = 65536):
+                 rowshard_min_capacity: int = 65536,
+                 flush_sched: bool = True):
         self.default_backend = default_backend
         # sparse delta staging of device-resident tick inputs (see
         # _TPUBucket._stage_inputs); False = full-restage baseline, kept
         # for perf A/B in bench.py
         self.delta_staging = delta_staging
+        # split-phase flush scheduler (docs/perf.md): True = issue-all-
+        # then-harvest across buckets; False = the forced-sequential
+        # baseline (each bucket dispatches AND harvests before the next
+        # starts), kept for perf A/B and parity tests
+        self.flush_sched = flush_sched
         self.oracle_algorithm = oracle_algorithm
         # "auto" routing threshold: spaces below it go to the native host
         # calculator (a tiny space is dispatch-bound on an accelerator;
@@ -454,16 +475,37 @@ class AOIEngine:
     def flush(self) -> None:
         """Execute all staged steps (one batched kernel per bucket); results
         are then available per space via :meth:`take_events` (one tick late
-        when pipelined)."""
-        for bucket in self._buckets.values():
-            bucket.flush()
+        when pipelined).
+
+        Split-phase scheduler (docs/perf.md): dispatch EVERY bucket first
+        (host pack + delta diff + H2D enqueue + kernel enqueue, never
+        blocking on device values), then harvest in dispatch order -- so
+        every bucket's kernel is in flight before the first fetch blocks,
+        and bucket N+1's device work overlaps bucket N's host decode.
+        Buckets iterate in sorted key order so dispatch/harvest order --
+        and therefore the fired order of fault-seam occurrences -- is
+        independent of space-creation interleaving.  ``flush_sched=False``
+        forces the sequential baseline: each bucket dispatches AND
+        harvests before the next starts."""
+        buckets = [self._buckets[k] for k in sorted(self._buckets)]
+        if not self.flush_sched:
+            for bucket in buckets:
+                bucket.dispatch()
+                bucket.harvest()
+            return
+        with _T.span("aoi.dispatch"):
+            for bucket in buckets:
+                bucket.dispatch()
+        with _T.span("aoi.harvest"):
+            for bucket in buckets:
+                bucket.harvest()
 
     def has_pending(self) -> bool:
         """True when a pipelined bucket holds a dispatched-but-unharvested
         tick (the runtime must keep flushing until it drains)."""
         return any(
-            getattr(b, "_inflight", None) is not None
-            for b in self._buckets.values()
+            getattr(self._buckets[k], "_inflight", None) is not None
+            for k in sorted(self._buckets)
         )
 
     def _telemetry_collect(self):
@@ -475,7 +517,7 @@ class AOIEngine:
         stats: dict[str, float] = {}
         perf: dict[str, float] = {}
         calc_level = 0
-        for b in self._buckets.values():
+        for b in (self._buckets[k] for k in sorted(self._buckets)):
             for k, v in getattr(b, "stats", {}).items():
                 if k == "calc_level":
                     calc_level = max(calc_level, v)
@@ -609,6 +651,18 @@ class _Bucket:
     def flush(self) -> None:
         raise NotImplementedError
 
+    def dispatch(self) -> None:
+        """Phase 1 of the split flush (docs/perf.md): enqueue this tick's
+        device work without blocking on device values.  Host-only buckets
+        dispatch-and-complete inline -- the default delegates to
+        :meth:`flush` -- so their harvest is a no-op.  Device buckets
+        override both phases."""
+        self.flush()
+
+    def harvest(self) -> None:
+        """Phase 2 of the split flush: fetch + decode whatever
+        :meth:`dispatch` enqueued (no-op for inline buckets)."""
+
     def drain(self) -> None:
         """Deliver any pipelined tick still in flight (no-op by default)."""
 
@@ -708,6 +762,11 @@ class _TPUBucket(_Bucket):
         self.pipeline = pipeline
         self.delta_staging = delta_staging
         self._inflight = None  # pending dispatch awaiting harvest
+        # split-phase flush (docs/perf.md): dispatch() parks what harvest()
+        # must do here -- ("inflight",) = drain the inflight record,
+        # ("rec", rec) = harvest a specific record, ("oracle", slots) =
+        # level-2 host compute deferred past the other buckets' dispatches
+        self._sched: tuple | None = None
         # per-slot release epoch: a pipelined harvest must NOT publish
         # events for a slot released (and possibly reused) after its
         # dispatch -- the new occupant would replay the dead space's pairs
@@ -934,25 +993,77 @@ class _TPUBucket(_Bucket):
         return self._mirror[slot]
 
     def flush(self) -> None:
+        """Monolithic flush = dispatch immediately followed by harvest (the
+        forced-sequential baseline; AOIEngine's scheduler calls the phases
+        directly to overlap buckets -- docs/perf.md)."""
+        self.dispatch()
+        self.harvest()
+
+    def dispatch(self) -> None:
+        """Phase 1: drain maintenance, pack + diff + H2D-enqueue this tick's
+        inputs and enqueue the jitted kernel -- never blocking on device
+        values (gwlint flush-phase rule).  What remains to be fetched is
+        parked in ``_sched`` for :meth:`harvest`."""
+        if self._sched is not None:
+            # re-entrant flush (get_prev/peek_words mid-scheduler): complete
+            # the previous phase pair before dispatching anew
+            self.harvest()  # gwlint: allow[flush-phase] -- re-entrant flush drains the prior dispatch first
         if not self._staged and not self._pending_reset and not self._pending_clear:
             # pipelined: a tick with nothing new still delivers the pending
             # tick's events (trailing flush)
             if self._inflight is not None:
-                self._harvest()
+                self._sched = ("inflight",)
             return
         if self._calc_level >= 2:
             # calculator fallback chain bottom: host-oracle mode -- the
-            # device is gone, every tick computes from the durable copies
-            self._flush_oracle()
+            # device is out of the loop; maintenance already reached the
+            # mirror (its device queues just drain) and the host compute
+            # itself defers to harvest so it overlaps other buckets'
+            # device work under the scheduler
+            self._pending_reset.clear()
+            self._pending_clear.clear()
+            if not self._staged:
+                if self._inflight is not None:
+                    self._sched = ("inflight",)
+                return
+            self._sched = ("oracle", self._restage_shadows())
             return
         try:
-            self._flush_device()
+            self._dispatch_device()
         except Exception as e:
             if not _device_fault(e):
                 raise
             self._recover(e)
 
-    def _flush_device(self) -> None:
+    def harvest(self) -> None:
+        """Phase 2: block on whatever :meth:`dispatch` parked -- the D2H
+        fetch + decode of the encoded event stream (or the deferred host
+        oracle tick).  A device fault surfacing here (async dispatch:
+        kernel errors materialize at the blocking fetch) recovers via
+        :meth:`_recover_harvest`."""
+        sched, self._sched = self._sched, None
+        if sched is None:
+            return
+        if sched[0] == "oracle":
+            if self._inflight is not None:
+                self._harvest()  # deliver T-1 before parking T (cadence)
+            self._host_tick(sched[1])
+            return
+        rec = self._inflight if sched[0] == "inflight" else sched[1]
+        if rec is None:
+            return
+        self._fault_phase = "harvest"
+        try:
+            if sched[0] == "inflight":
+                self._harvest()
+            else:
+                self._harvest(rec)
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self._recover_harvest(e, rec)
+
+    def _dispatch_device(self) -> None:
         import jax.numpy as jnp
 
         c = self.capacity
@@ -994,8 +1105,10 @@ class _TPUBucket(_Bucket):
                 jnp.asarray([m for _, _, m in cols], jnp.uint32),
             )
         if not self._staged:
+            # maintenance-only tick: nothing dispatched, but a pending
+            # pipelined tick still delivers -- at harvest time
             if self._inflight is not None:
-                self._harvest()
+                self._sched = ("inflight",)
             return
 
         t_stage0 = time.perf_counter()
@@ -1087,14 +1200,17 @@ class _TPUBucket(_Bucket):
         prev_rec, self._inflight = self._inflight, rec
         self.perf["stage_s"] += time.perf_counter() - t_stage0
         if self.pipeline:
+            # tick T dispatched; T-1's record (whose D2H was prefetched at
+            # its own dispatch) harvests in phase 2
             if prev_rec is not None:
-                self._harvest(prev_rec)
+                self._sched = ("rec", prev_rec)
         else:
-            self._harvest()
+            self._sched = ("inflight",)
 
     def drain(self) -> None:
         """Harvest a pending pipelined tick without dispatching a new one
         (shutdown, state carry-over, tests)."""
+        self.harvest()
         if self._inflight is not None:
             self._harvest()
 
@@ -1184,7 +1300,7 @@ class _TPUBucket(_Bucket):
                 self._hx[s], self._hz[s], self._hr[s], self._hact[s])
         self._mirror_stale.clear()
 
-    def _recover(self, e: BaseException) -> None:
+    def _recover(self, e: BaseException) -> None:  # gwlint: allow[flush-phase] -- fault recovery: the device is gone, host sync is the point
         """Device fault mid-flush: deliver the inflight tick, recompute the
         faulted tick host-side (bit-exact), drop device state."""
         from ..utils import gwlog
@@ -1232,13 +1348,86 @@ class _TPUBucket(_Bucket):
         if slots:
             self._host_tick(slots)
 
-    def _host_tick(self, slots: list[int]) -> None:
+    def _recover_harvest(self, e: BaseException, rec: dict) -> None:  # gwlint: allow[flush-phase] -- fault recovery: the device is gone, host sync is the point
+        """Device fault surfacing at HARVEST time (split-phase flush: the
+        blocking fetch is where async kernel/transfer errors materialize).
+        The faulted record's stream is unrecoverable from the device, but
+        the durable copies bracket it exactly: the mirror still holds the
+        state BEFORE the record's tick (its XOR never applied) and the
+        shadows hold the newest staged inputs -- so one host predicate pass
+        regenerates the lost events as a single coalesced diff, published
+        immediately in place of the record's due delivery (bit-exact for
+        the non-pipelined path; pipelined, the faulted tick and the one
+        dispatched after it coalesce -- docs/robustness.md)."""
+        from ..utils import gwlog
+
+        self.stats["rebuilds"] += 1
+        if _kernelish_fault(e) and self._calc_level < 2:
+            self._calc_level += 1
+            self.stats["fallbacks"] += 1
+            self.stats["calc_level"] = self._calc_level
+        gwlog.logger("gw.aoi").warning(
+            "AOI bucket (cap %d) device fault during harvest: %s -- "
+            "regenerating the tick's events on host (calc level %d)",
+            self.capacity, e, self._calc_level)
+        # a host-synthetic record cannot fault here (its harvest never
+        # touches the device), but stay defensive: its events and mirror
+        # effects are already final, so just re-publish its payload
+        if rec.get("host"):
+            chg_vals, ent_vals, gidx, s_n = rec["payload"]
+            self._publish(rec["slots"], rec["epochs"], chg_vals, ent_vals,
+                          gidx, s_n)
+            rec_slots: list[int] = []
+        else:
+            rec_slots = rec["slots"]
+        # the record dispatched AFTER the faulted one (pipelined) is on the
+        # same dead device; fold its slots into the recompute.  A synthetic
+        # inflight stays parked -- its mirror effects already landed and
+        # its delivery schedule is unchanged.
+        newest, self._inflight = self._inflight, None
+        host_rec = None
+        if newest is not None:
+            if newest.get("host"):
+                host_rec = newest
+            else:
+                rec_slots = sorted(set(rec_slots) | set(newest["slots"]))
+        self._ensure_mirror()
+        # mirror maintenance that was deferred behind the (now lost) stream
+        # XOR, plus device-queue maintenance that never reached prev: land
+        # everything on the mirror (idempotent)
+        if self._mirror_ops:
+            ops, self._mirror_ops = self._mirror_ops, []
+            for op in ops:
+                if self._slot_epoch.get(op[1], 0) == op[-1]:
+                    self._mirror_apply_now(op[:-1])
+        for s in sorted(self._pending_reset):
+            self._mirror_apply_now(("reset", s))
+        for s, ent in self._pending_clear:
+            self._mirror_apply_now(("clear", s, ent))
+        self._pending_reset.clear()
+        self._pending_clear.clear()
+        if self._staged:  # defensive: inputs staged between the phases
+            rec_slots = sorted(set(rec_slots) | set(self._restage_shadows()))
+        self._cur_slots = []
+        # device state is gone; the next dispatch rebuilds from the mirror
+        self.prev = None
+        self._dev.clear()
+        self._dev_stale = {"xz", "ra", "sub"}
+        self._scratch.clear()
+        self._need_rebuild = self._calc_level < 2
+        if rec_slots:
+            self._host_tick(rec_slots, publish_now=True)
+        self._inflight = host_rec
+
+    def _host_tick(self, slots: list[int], publish_now: bool = False) -> None:
         """One bucket tick on the host from the durable copies, bit-exact
         with the device step: new = predicate(shadows) per staged slot,
         chg = new XOR mirror (masked for unsubscribed slots), and the
         event stream in np.nonzero's ascending flat order -- exactly the
         device chunk-extraction order (the cap-overflow recovery path in
-        _harvest decodes the same way)."""
+        _harvest decodes the same way).  ``publish_now`` skips the
+        pipelined one-tick-late parking: harvest-time recovery substitutes
+        this tick for the faulted record's due delivery."""
         c, W = self.capacity, self.W
         s_n = len(slots)
         self.stats["host_ticks"] += 1
@@ -1258,7 +1447,7 @@ class _TPUBucket(_Bucket):
         ent_vals = chg_vals & new.reshape(-1)[gidx]
         self._mirror[sl] = new
         epochs = [self._slot_epoch.get(s, 0) for s in slots]
-        if self.pipeline:
+        if self.pipeline and not publish_now:
             # pipelined cadence: events are delivered one tick late, so a
             # recovered tick parks as a synthetic inflight record and
             # publishes at the NEXT flush, exactly like a device tick
@@ -1268,21 +1457,6 @@ class _TPUBucket(_Bucket):
         else:
             self._publish(slots, epochs, chg_vals, ent_vals, gidx, s_n)
         _T.lap("aoi.host_tick", _th)
-
-    def _flush_oracle(self) -> None:
-        """Level-2 fallback flush: the device is out of the loop entirely;
-        maintenance already reached the mirror (its device queues just
-        drain) and every staged tick computes host-side."""
-        self._pending_reset.clear()
-        self._pending_clear.clear()
-        if not self._staged:
-            if self._inflight is not None:
-                self._harvest()
-            return
-        slots = self._restage_shadows()
-        if self._inflight is not None:
-            self._harvest()  # deliver T-1 before parking T (cadence)
-        self._host_tick(slots)
 
     def _harvest(self, rec=None) -> None:  # gwlint: allow[host-sync] -- THE per-tick drain point: harvests kernel outputs once per flush
         """Fetch + decode one dispatched tick's event stream and publish its

@@ -64,8 +64,8 @@ from .. import faults
 from ..telemetry import trace as _T
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import (_Bucket, _CapDecay, _device_fault, _packed_predicate,
-                  _split_rows)
+from .aoi import (_Bucket, _CapDecay, _device_fault, _kernelish_fault,
+                  _packed_predicate, _split_rows)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -142,6 +142,9 @@ class _MeshTPUBucket(_Bucket):
                       "poisoned": 0, "calc_level": 0}
         # pipelined tick awaiting harvest
         self._inflight = None
+        # split-phase flush (docs/perf.md): dispatch() parks what harvest()
+        # must do (see _TPUBucket._sched for the grammar)
+        self._sched: tuple | None = None
         # per-slot release epoch: a harvest must not publish events (or XOR
         # mirror traffic) for a slot released after its dispatch
         self._slot_epoch: dict[int, int] = {}
@@ -586,24 +589,75 @@ class _MeshTPUBucket(_Bucket):
         return key, sc
 
     def flush(self) -> None:
+        """Monolithic flush = dispatch immediately followed by harvest (the
+        forced-sequential baseline; see _TPUBucket.flush)."""
+        self.dispatch()
+        self.harvest()
+
+    def dispatch(self) -> None:
+        """Phase 1 of the split flush: maintenance + pack + H2D enqueue +
+        sharded-kernel enqueue, never blocking on device values (gwlint
+        flush-phase rule); parks the harvest work in ``_sched``."""
+        if self._sched is not None:
+            self.harvest()  # gwlint: allow[flush-phase] -- re-entrant flush drains the prior dispatch first
         if (not self._staged and not self._pending_reset
                 and not self._pending_clear):
             if self._inflight is not None:
-                self._harvest()
+                self._sched = ("inflight",)
             return
         if self._calc_level >= 2:
             # calculator fallback chain bottom: host-oracle mode -- the
-            # device is gone, every tick computes from the durable copies
-            self._flush_oracle()
+            # device is out of the loop; maintenance already reached the
+            # mirror when issued, and the host compute defers to harvest
+            # so it overlaps other buckets' device work
+            self._pending_reset.clear()
+            self._pending_clear.clear()
+            if not self._staged:
+                if self._inflight is not None:
+                    self._sched = ("inflight",)
+                return
+            slots = self._restage_shadows()
+            if self._seeded_unstaged:
+                raise RuntimeError(
+                    "mesh AOI bucket: slots %r carry seeded interest state "
+                    "but were not staged before flush -- stepping them would "
+                    "emit a spurious mass-leave (stage the space first)"
+                    % sorted(self._seeded_unstaged))
+            self._sched = ("oracle", slots)
             return
         try:
-            self._flush_device()
+            self._dispatch_device()
         except Exception as e:
             if not _device_fault(e):
                 raise
             self._recover(e)
 
-    def _flush_device(self) -> None:  # gwlint: allow[host-sync] -- flush epilogue hands results to the harvest drain
+    def harvest(self) -> None:
+        """Phase 2 of the split flush: the blocking fetch + decode of what
+        :meth:`dispatch` parked (see _TPUBucket.harvest)."""
+        sched, self._sched = self._sched, None
+        if sched is None:
+            return
+        if sched[0] == "oracle":
+            if self._inflight is not None:
+                self._harvest()  # deliver T-1 before parking T (cadence)
+            self._host_tick(sched[1])
+            return
+        rec = self._inflight if sched[0] == "inflight" else sched[1]
+        if rec is None:
+            return
+        self._fault_phase = "harvest"
+        try:
+            if sched[0] == "inflight":
+                self._harvest()
+            else:
+                self._harvest(rec)
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self._recover_harvest(e, rec)
+
+    def _dispatch_device(self) -> None:  # gwlint: allow[host-sync] -- pre-dispatch overflow peek reads an async-fetched host-local scalar
         t0 = time.perf_counter()
         _ts = _T.t()
         self._fault_phase = "stage"
@@ -618,19 +672,22 @@ class _MeshTPUBucket(_Bucket):
             # Harvest BEFORE both in that rare case; the pipeline stalls
             # one tick instead of misclassifying or reading freed memory.
             # (an all-unsub tick cannot overflow: its stream is empty)
-            nd_mcc = np.asarray(self._inflight["scalars"])[:, :2]
+            nd_mcc = np.asarray(self._inflight["scalars"])[:, :2]  # gwlint: allow[flush-phase] -- async-fetched at T-1's dispatch, host-local by now
             mc_i, kcap_i = self._inflight["caps"][:2]
             if (nd_mcc[:, 0] > mc_i).any() or (nd_mcc[:, 1] > kcap_i).any():
-                self._harvest()
+                self._harvest()  # gwlint: allow[flush-phase] -- rare overflow: stall one tick rather than read donated memory
         self._rebuild_device()
         self._apply_maintenance()
         if not self._staged:
+            # maintenance-only tick: a pending pipelined tick still
+            # delivers -- at harvest time
             if self._inflight is not None:
-                self._harvest()
+                self._sched = ("inflight",)
             return
 
         staged_slots = sorted(self._staged)
-        sl = np.asarray(staged_slots, np.intp)
+        # np.array (not asarray): packs a host python list, no device sync
+        sl = np.array(staged_slots, np.intp)
         # save the previously staged rows (fancy index -> compact copies)
         # before overwriting: _stage_xz diffs the new tick against them
         old_x, old_z = self._hx[sl], self._hz[sl]
@@ -711,11 +768,12 @@ class _MeshTPUBucket(_Bucket):
         self.perf["stage_s"] += time.perf_counter() - t0
         if self.pipeline:
             if prev_rec is not None:
-                self._harvest(prev_rec)
+                self._sched = ("rec", prev_rec)
         else:
-            self._harvest()
+            self._sched = ("inflight",)
 
     def drain(self) -> None:
+        self.harvest()
         if self._inflight is not None:
             self._harvest()
 
@@ -796,7 +854,7 @@ class _MeshTPUBucket(_Bucket):
                 self._hx[s], self._hz[s], self._hr[s], self._hact[s])
         self._mirror_stale.clear()
 
-    def _recover(self, e: BaseException) -> None:
+    def _recover(self, e: BaseException) -> None:  # gwlint: allow[flush-phase] -- fault recovery: the device is gone, host sync is the point
         """Device fault mid-flush: deliver the inflight tick, recompute the
         faulted tick host-side (bit-exact), drop all device state."""
         from ..utils import gwlog
@@ -848,9 +906,69 @@ class _MeshTPUBucket(_Bucket):
         if slots:
             self._host_tick(slots)
 
-    def _host_tick(self, slots: list[int]) -> None:
+    def _recover_harvest(self, e: BaseException, rec: dict) -> None:  # gwlint: allow[flush-phase] -- fault recovery: the device is gone, host sync is the point
+        """Device fault surfacing at HARVEST time (see
+        _TPUBucket._recover_harvest for the full contract): the mirror
+        still predates the faulted record's XOR and the shadows hold the
+        newest staged inputs, so one host predicate pass regenerates the
+        lost events as a coalesced diff, published immediately."""
+        from ..utils import gwlog
+
+        self.stats["rebuilds"] += 1
+        if _kernelish_fault(e) and self._calc_level < 2:
+            self._calc_level += 1
+            self.stats["fallbacks"] += 1
+            self.stats["calc_level"] = self._calc_level
+        gwlog.logger("gw.aoi").warning(
+            "mesh AOI bucket (cap %d) device fault during harvest: %s -- "
+            "regenerating the tick's events on host (calc level %d)",
+            self.capacity, e, self._calc_level)
+        if rec.get("host"):  # defensive: a synthetic record never faults
+            chg_vals, ent_vals, gidx, s_n = rec["payload"]
+            self._publish(rec["slots"], rec["epochs"], chg_vals, ent_vals,
+                          gidx, s_n)
+            rec_slots: list[int] = []
+        else:
+            rec_slots = rec["slots"]
+        newest, self._inflight = self._inflight, None
+        host_rec = None
+        if newest is not None:
+            if newest.get("host"):
+                host_rec = newest
+            else:
+                rec_slots = sorted(set(rec_slots) | set(newest["slots"]))
+        self._ensure_mirror()
+        # deferred mirror maintenance (behind the now-lost stream XOR) plus
+        # device-queue maintenance that never reached prev: land everything
+        # on the mirror (idempotent)
+        if self._mirror_ops:
+            ops, self._mirror_ops = self._mirror_ops, []
+            for op in ops:
+                if self._slot_epoch.get(op[0], 0) == op[-1]:
+                    self._mirror_clear(op[0], op[1])
+        for s in sorted(self._pending_reset):
+            self._mirror[s] = 0
+        for s, ent in self._pending_clear:
+            self._mirror_clear(s, ent)
+        self._pending_reset.clear()
+        self._pending_clear.clear()
+        if self._staged:  # defensive: inputs staged between the phases
+            rec_slots = sorted(set(rec_slots) | set(self._restage_shadows()))
+        self._cur_slots = []
+        self.prev = None
+        self._dx = self._dz = None
+        self._xz_stale = True
+        self._h2d_cache.clear()
+        self._scratch.clear()
+        self._need_rebuild = self._calc_level < 2
+        if rec_slots:
+            self._host_tick(rec_slots, publish_now=True)
+        self._inflight = host_rec
+
+    def _host_tick(self, slots: list[int], publish_now: bool = False) -> None:
         """One bucket tick on the host from the durable copies, bit-exact
-        with the sharded step (see _TPUBucket._host_tick)."""
+        with the sharded step (see _TPUBucket._host_tick; ``publish_now``
+        skips the pipelined parking for harvest-time recovery)."""
         c, W = self.capacity, self.W
         s_n = len(slots)
         self.stats["host_ticks"] += 1
@@ -870,7 +988,7 @@ class _MeshTPUBucket(_Bucket):
         ent_vals = chg_vals & new.reshape(-1)[gidx]
         self._mirror[sl] = new
         epochs = [self._slot_epoch.get(s, 0) for s in slots]
-        if self.pipeline:
+        if self.pipeline and not publish_now:
             # pipelined cadence: events deliver one tick late, so the
             # recovered tick parks as a synthetic inflight record
             self._inflight = {"host": True, "slots": slots,
@@ -879,27 +997,6 @@ class _MeshTPUBucket(_Bucket):
         else:
             self._publish(slots, epochs, chg_vals, ent_vals, gidx, s_n)
         _T.lap("aoi.host_tick", _th)
-
-    def _flush_oracle(self) -> None:
-        """Level-2 fallback flush: the device is out of the loop entirely;
-        maintenance already reached the mirror when it was issued, so the
-        device queues just drain."""
-        self._pending_reset.clear()
-        self._pending_clear.clear()
-        if not self._staged:
-            if self._inflight is not None:
-                self._harvest()
-            return
-        slots = self._restage_shadows()
-        if self._seeded_unstaged:
-            raise RuntimeError(
-                "mesh AOI bucket: slots %r carry seeded interest state but "
-                "were not staged before flush -- stepping them would emit a "
-                "spurious mass-leave (stage the space first)"
-                % sorted(self._seeded_unstaged))
-        if self._inflight is not None:
-            self._harvest()  # deliver T-1 before parking T (cadence)
-        self._host_tick(slots)
 
     def _apply_deferred_mirror_ops(self) -> None:
         """Clears issued after a tick's dispatch apply now, AFTER its

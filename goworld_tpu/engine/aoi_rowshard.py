@@ -50,7 +50,8 @@ from .. import faults
 from ..telemetry import trace as _T
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import _Bucket, _CapDecay, _device_fault, _packed_predicate
+from .aoi import (_Bucket, _CapDecay, _device_fault, _kernelish_fault,
+                  _packed_predicate)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -115,6 +116,10 @@ class _RowShardTPUBucket(_Bucket):
         self._host_prev: np.ndarray | None = None
         self._cur_old: tuple | None = None
         self._tick_inflight = False  # restage done, events not yet harvested
+        # split-phase flush (docs/perf.md): dispatch() parks what harvest()
+        # must do (see _TPUBucket._sched for the grammar); this bucket is
+        # not pipelined, so the parked record is always the CURRENT tick's
+        self._sched: tuple | None = None
         self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
                       "poisoned": 0, "calc_level": 0}
@@ -421,16 +426,54 @@ class _RowShardTPUBucket(_Bucket):
         return key, sc
 
     def flush(self) -> None:
+        """Monolithic flush = dispatch immediately followed by harvest (the
+        forced-sequential baseline; see _TPUBucket.flush).  Events always
+        arrive same-tick -- this bucket is never pipelined across ticks."""
+        self.dispatch()
+        self.harvest()
+
+    def dispatch(self) -> None:
+        """Phase 1 of the split flush: maintenance + restage + H2D enqueue
+        + rectangular-kernel enqueue, never blocking on device values
+        (gwlint flush-phase rule); parks the harvest work in ``_sched``."""
+        if self._sched is not None:
+            self.harvest()  # gwlint: allow[flush-phase] -- re-entrant flush drains the prior dispatch first
         if self._calc_level >= 2:
-            # calculator fallback chain bottom: host-oracle mode
-            self._flush_oracle()
+            # calculator fallback chain bottom: host-oracle mode; the host
+            # compute defers to harvest so it overlaps other buckets
+            self._dispatch_oracle()
             return
         try:
-            self._flush_device()
+            self._dispatch_device()
         except Exception as e:
             if not _device_fault(e):
                 raise
             self._recover(e)
+
+    def harvest(self) -> None:
+        """Phase 2 of the split flush: the blocking per-chip fetch + decode
+        of what :meth:`dispatch` enqueued.  ``_tick_inflight`` (and a live
+        set_prev seed) stay armed until the events actually land, so a
+        fault surfacing at the fetch recovers bit-exactly from the pre-tick
+        durable state (_cur_old / _seed_prev)."""
+        sched, self._sched = self._sched, None
+        if sched is None:
+            return
+        if sched[0] == "oracle":
+            self._host_tick(sched[1])
+            return
+        self._fault_phase = "harvest"
+        try:
+            self._harvest(sched[1])
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self._recover(e)
+            return
+        # the tick delivered: prev == predicate(shadows) again, so a
+        # set_prev seed is no longer the recovery base
+        self._seed_prev = None
+        self._tick_inflight = False
 
     def _restage_shadows(self) -> None:
         """Pop the staged tick into the persistent shadows, keeping the
@@ -447,7 +490,7 @@ class _RowShardTPUBucket(_Bucket):
         self._hact[:n] = sa
         self._staged.clear()
 
-    def _flush_device(self) -> None:  # gwlint: allow[host-sync] -- flush epilogue hands results to the harvest drain
+    def _dispatch_device(self) -> None:
         self._fault_phase = "stage"
         self._apply_maintenance()
         if not self._staged:
@@ -460,7 +503,8 @@ class _RowShardTPUBucket(_Bucket):
         self._ensure_prev()
         key, scratch = self._get_scratch()
         self._stage_xz(old_x, old_z, old_r, old_act)
-        sub = self._h2d("sub", np.asarray(self._subscribed), replicated=True)
+        # np.array (not asarray): a host python bool, no device sync here
+        sub = self._h2d("sub", np.array(self._subscribed), replicated=True)
         _T.lap("aoi.stage", _ts)
         _tk = _T.t()
         self._fault_phase = "kernel"
@@ -501,18 +545,17 @@ class _RowShardTPUBucket(_Bucket):
                 slices.append(sl)
             pf = (ndp, escp, excp, slices)
         self.perf["stage_s"] += time.perf_counter() - t0
-        self._harvest(
-            {"caps": (self._max_chunks, self._kcap, self._max_gaps,
-                      self._max_exc),
-             "key": key,
-             "scratch": (chg, g_vals, g_nv, g_lane, g_csel),
-             "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
-                         exc_new),
-             "scalars": scalars, "prefetch": pf})
-        # the tick delivered: prev == predicate(shadows) again, so a
-        # set_prev seed is no longer the recovery base
-        self._seed_prev = None
-        self._tick_inflight = False
+        # everything above is enqueue-only; the blocking fetch + decode
+        # happen in harvest() (split-phase flush) -- _tick_inflight and any
+        # set_prev seed stay armed until the events actually land
+        self._sched = ("rec", {
+            "caps": (self._max_chunks, self._kcap, self._max_gaps,
+                     self._max_exc),
+            "key": key,
+            "scratch": (chg, g_vals, g_nv, g_lane, g_csel),
+            "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+                        exc_new),
+            "scalars": scalars, "prefetch": pf})
 
     def _harvest(self, rec) -> None:  # gwlint: allow[host-sync] -- THE per-tick drain point: harvests kernel outputs once per flush
         c = self.capacity
@@ -537,8 +580,9 @@ class _RowShardTPUBucket(_Bucket):
                 and (scal_h[:, 4] <= nw).all()):
             # garbage control scalars: distrust the encoded streams and
             # recover every chip from its raw diff grid (no cap growth off
-            # corrupted values).  The flush is synchronous, so self.prev
-            # still holds THIS tick's new words
+            # corrupted values).  No other dispatch intervenes between the
+            # phases (one bucket per space), so self.prev still holds THIS
+            # tick's new words
             from ..utils import gwlog
 
             self.stats["poisoned"] += 1
@@ -705,13 +749,19 @@ class _RowShardTPUBucket(_Bucket):
         self._pending_clear.clear()
         return old
 
-    def _recover(self, e: BaseException) -> None:
+    def _recover(self, e: BaseException) -> None:  # gwlint: allow[flush-phase] -- fault recovery: the device is gone, host sync is the point
         """Device fault mid-flush: recompute the faulted tick host-side
         (bit-exact) and drop all device state."""
         from ..utils import gwlog
 
         self.stats["rebuilds"] += 1
-        if self._fault_phase == "kernel" and self._calc_level < 2:
+        # kernel-phase faults demote outright; at harvest time the seam
+        # cannot tell a kernel error from a transfer fault (async dispatch:
+        # both surface at the blocking fetch), so the decision keys off the
+        # exception class (_kernelish_fault)
+        if (self._fault_phase == "kernel"
+                or (self._fault_phase == "harvest" and _kernelish_fault(e))) \
+                and self._calc_level < 2:
             self._calc_level += 1
             self.stats["fallbacks"] += 1
             self.stats["calc_level"] = self._calc_level
@@ -719,7 +769,7 @@ class _RowShardTPUBucket(_Bucket):
             "row-shard AOI bucket (cap %d) device fault during %s: %s -- "
             "recovering tick on host (calc level %d)",
             self.capacity, self._fault_phase, e, self._calc_level)
-        # _flush_device restages BEFORE the device seams, so at fault time
+        # _dispatch_device restages BEFORE the device seams, so at fault time
         # the tick may already live in the shadows (_tick_inflight) rather
         # than in _staged -- both mean "a tick's events must be recovered"
         inflight = self._tick_inflight
@@ -780,9 +830,11 @@ class _RowShardTPUBucket(_Bucket):
         self._cur_old = None
         _T.lap("aoi.host_tick", _th)
 
-    def _flush_oracle(self) -> None:
-        """Level-2 fallback flush: the device is out of the loop entirely;
-        _host_prev is the authoritative state."""
+    def _dispatch_oracle(self) -> None:
+        """Level-2 fallback dispatch: the device is out of the loop
+        entirely; _host_prev is the authoritative state.  Maintenance and
+        restaging run now, the host compute parks for harvest() so it
+        overlaps other buckets' device work under the scheduler."""
         if self._host_prev is None:
             self._host_prev = np.zeros((self.capacity, self.W), np.uint32)
         if self._pending_clear:
@@ -798,7 +850,7 @@ class _RowShardTPUBucket(_Bucket):
         self._restage_shadows()
         old_prev = self._seed_prev if self._seed_prev is not None \
             else self._host_prev
-        self._host_tick(old_prev)
+        self._sched = ("oracle", old_prev)
 
     # -- state carry / lazy derivation --------------------------------------
     def get_prev(self, slot: int) -> np.ndarray:  # gwlint: allow[host-sync] -- parity/debug accessor, off the tick path

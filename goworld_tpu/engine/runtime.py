@@ -48,6 +48,7 @@ class Runtime:
         aoi_delta_staging: bool = True,
         aoi_tpu_min_capacity: int = 4096,
         aoi_rowshard_min_capacity: int = 65536,
+        aoi_flush_sched: bool = True,
         fault_plan: "faults.FaultPlan | str | None" = None,
         telemetry_on: bool = False,
     ):
@@ -71,7 +72,8 @@ class Runtime:
                              pipeline=aoi_pipeline,
                              delta_staging=aoi_delta_staging,
                              tpu_min_capacity=aoi_tpu_min_capacity,
-                             rowshard_min_capacity=aoi_rowshard_min_capacity)
+                             rowshard_min_capacity=aoi_rowshard_min_capacity,
+                             flush_sched=aoi_flush_sched)
         self.entities = EntityManager(self)
         self.tick_count = 0
         # entities with pending sync flags / attr deltas / quiet countdowns;
@@ -120,6 +122,9 @@ class Runtime:
         # is staged (trailing flush); events can land on any AOI space, not
         # just the ones staged this tick
         if staged or self.aoi.has_pending():
+            # the flush span nests aoi.dispatch + aoi.harvest (the split-
+            # phase scheduler, docs/perf.md): dispatch of EVERY bucket
+            # precedes the first blocking fetch
             with _trace.span("aoi.flush"):
                 self.aoi.flush()
             with _trace.span("aoi.emit"):

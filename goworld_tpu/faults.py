@@ -26,9 +26,16 @@ seam                      fires in
 ``aoi.grow``              device allocation when a bucket grows its slots
 ``aoi.h2d``               full role-array upload (``_h2d``) during staging
 ``aoi.delta``             sparse delta-packet scatter during staging
-``aoi.kernel``            the fused AOI kernel launch (bucket step)
-``aoi.scalars``           control-scalar fetch (poison: corrupt the values)
-``aoi.fetch``             event-stream harvest (stall: delay the host sync)
+``aoi.kernel``            the fused AOI kernel launch (bucket step) --
+                          enqueued at dispatch; a real async-dispatch
+                          error would surface at harvest, which the
+                          ``aoi.fetch`` kinds model
+``aoi.scalars``           control-scalar fetch (poison: corrupt the
+                          values) -- issued async at dispatch, validated
+                          at harvest decode
+``aoi.fetch``             event-stream harvest drain (stall: delay the
+                          host sync; fail/oom: the fault a dispatched
+                          kernel surfaces at its blocking fetch)
 ``conn.send``             typed packet send (proto/connection.py)
 ``conn.flush``            framed batch write (netutil/conn.py flush)
 ``conn.recv``             blocking packet read (netutil/conn.py recv)
@@ -69,9 +76,10 @@ SEAMS = {
     "aoi.grow": "device allocation on bucket slot growth",
     "aoi.h2d": "full role-array upload during input staging",
     "aoi.delta": "sparse delta-packet scatter during input staging",
-    "aoi.kernel": "fused AOI kernel launch",
-    "aoi.scalars": "control-scalar fetch (poisonable)",
-    "aoi.fetch": "event-stream harvest host sync (stallable)",
+    "aoi.kernel": "fused AOI kernel launch (enqueued at dispatch)",
+    "aoi.scalars": "control-scalar fetch (poisonable; validated at harvest)",
+    "aoi.fetch": "harvest-phase host sync (stallable; fail/oom = async "
+                 "dispatch errors surfacing at the blocking fetch)",
     "conn.send": "typed packet send",
     "conn.flush": "framed batch write",
     "conn.recv": "blocking packet read",

@@ -238,6 +238,11 @@ def decode_row_stream(rowb, bitpos, woff, base_row, n_dirty, w,  # gwlint: allow
                       esc_rows, exc_gidx, exc_chg, exc_new):
     """Host-side (numpy) inverse of :func:`encode_row_stream`.
 
+    Harvest-phase only (docs/perf.md split flush): the inputs are the
+    already-drained host copies of the encoded stream -- callers run this
+    from ``harvest()`` after the blocking fetch, never from ``dispatch()``
+    (the flush-phase gwlint rule enforces the reachability).
+
     Returns ``(chg_vals u32 [K], ent_vals u32 [K], gidx i64 [K])`` --
     ent_vals are the enter-bit subsets (``chg & new``), directly consumable
     by :func:`expand_classified_host` (which sorts, so main-stream/exc
@@ -337,6 +342,10 @@ def expand_words_host(vals, flat_idx, capacity: int, n_spaces: int):  # gwlint: 
 def expand_classified_host(chg_vals, ent_vals, flat_idx, capacity: int,  # gwlint: allow[host-sync] -- host-side expansion of the drained stream
                            n_spaces: int):
     """One-pass expansion of a classified change stream.
+
+    Harvest-phase only, like :func:`decode_row_stream`: the per-bucket
+    ``harvest()`` feeds it decoded host values after the fetch; nothing on
+    the dispatch side may reach it.
 
     ``chg_vals`` are the changed words, ``ent_vals`` their enter-bit subsets
     (``chg & new``, from :func:`decode_word_stream` with_enter).  Returns

@@ -36,7 +36,13 @@ JAX_PLATFORMS=cpu python scripts/faults_smoke.py || fail=1
 echo "== telemetry smoke =="
 JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py || fail=1
 
-# 6. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 6. split-phase flush scheduler smoke (CPU backend: scheduler-on vs
+#    forced-sequential A/B over two bucket capacities; bit-exact parity
+#    plus the aoi.dispatch/aoi.harvest span ordering -- docs/perf.md)
+echo "== flush_sched smoke =="
+JAX_PLATFORMS=cpu python scripts/flush_sched_smoke.py || fail=1
+
+# 7. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

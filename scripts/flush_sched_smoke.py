@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""CPU-only smoke test of the split-phase flush scheduler.
+
+A ci.sh step (and a standalone sanity check): the same sparse walk over
+TWO bucket capacities runs once with the issue-all-then-harvest
+scheduler (``flush_sched=True``) and once forced sequential; the
+enter/leave streams must match each other and the CPU oracle
+bit-for-bit, the scheduler run must emit one "aoi.dispatch" +
+"aoi.harvest" span pair per flush with every dispatch closing before the
+harvest opens, and the span timestamps yield the overlap report
+(docs/perf.md: on CPU the phases are host-serial, so the report is a
+plumbing check, not a perf gate -- the perf claim lives in bench.py's
+engine_sched A/B on real devices).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from goworld_tpu import telemetry  # noqa: E402
+from goworld_tpu.engine.aoi import AOIEngine  # noqa: E402
+from goworld_tpu.telemetry import trace  # noqa: E402
+
+CAPS = (256, 512)
+
+
+def main():
+    n, ticks = 180, 6
+    rng = np.random.default_rng(21)
+    scenes = []
+    for cap in CAPS:
+        xs = rng.uniform(0, 600, n).astype(np.float32)
+        zs = rng.uniform(0, 600, n).astype(np.float32)
+        rr = rng.uniform(60, 120, n).astype(np.float32)
+        act = np.zeros(cap, bool)
+        act[:n] = True
+        scenes.append([xs, zs, rr, act])
+
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu", flush_sched=False),
+        "sched": AOIEngine(default_backend="tpu", flush_sched=True),
+        "seq": AOIEngine(default_backend="tpu", flush_sched=False),
+    }
+    handles = {k: [e.create_space(c) for c in CAPS]
+               for k, e in engines.items()}
+
+    def pad(a, cap):
+        o = np.zeros(cap, a.dtype)
+        o[: len(a)] = a
+        return o
+
+    telemetry.enable()
+    trace.reset()
+    try:
+        for t in range(ticks):
+            for (xs, zs, _rr, _act) in scenes:
+                movers = rng.random(n) < 0.1
+                dx = rng.uniform(-15, 15, int(movers.sum()))
+                dz = rng.uniform(-15, 15, int(movers.sum()))
+                xs[movers] += dx.astype(np.float32)
+                zs[movers] += dz.astype(np.float32)
+            evs = {}
+            for k, e in engines.items():
+                for (xs, zs, rr, act), h, cap in zip(
+                        scenes, handles[k], CAPS):
+                    e.submit(h, pad(xs, cap), pad(zs, cap), pad(rr, cap),
+                             act.copy())
+                e.flush()
+                evs[k] = [e.take_events(h) for h in handles[k]]
+            for k in ("sched", "seq"):
+                for si in range(len(CAPS)):
+                    np.testing.assert_array_equal(
+                        evs["cpu"][si][0], evs[k][si][0],
+                        err_msg=f"{k} space {si} enter tick {t}")
+                    np.testing.assert_array_equal(
+                        evs["cpu"][si][1], evs[k][si][1],
+                        err_msg=f"{k} space {si} leave tick {t}")
+        spans = [(nm, t0, t1) for nm, _tid, t0, t1 in trace.spans()
+                 if nm in ("aoi.dispatch", "aoi.harvest")]
+    finally:
+        telemetry.disable()
+
+    dispatches = [s for s in spans if s[0] == "aoi.dispatch"]
+    harvests = [s for s in spans if s[0] == "aoi.harvest"]
+    assert len(dispatches) == ticks, (len(dispatches), ticks)
+    assert len(harvests) == ticks, (len(harvests), ticks)
+    d_s = h_s = 0.0
+    for (_d, d0, d1), (_h, h0, h1) in zip(dispatches, harvests):
+        assert d1 <= h0, "a harvest fetch ran before dispatch finished"
+        d_s += d1 - d0
+        h_s += h1 - h0
+    # overlap gain proxy: device work enqueued per tick that a sequential
+    # flush would serialize behind the previous bucket's harvest.  CPU jax
+    # executes eagerly, so this prints the plumbing numbers only.
+    print(f"flush_sched_smoke: OK -- {ticks} ticks x {len(CAPS)} buckets "
+          f"bit-exact (sched == seq == oracle); "
+          f"dispatch {d_s * 1e3 / ticks:.3f} ms/tick, "
+          f"harvest {h_s * 1e3 / ticks:.3f} ms/tick, "
+          f"all {ticks} dispatch spans closed before their harvest opened")
+
+
+if __name__ == "__main__":
+    main()

@@ -235,6 +235,33 @@ def test_fetch_stall_is_transparent():
     assert any(f["kind"] == "stall" for f in faults.plan().fired)
 
 
+def test_harvest_phase_kernel_fault_bitexact():
+    """aoi.fetch:fail -- the async-dispatch reality: a kernel error
+    materializes at the harvest fetch, after dispatch() already returned
+    (split-phase flush, docs/perf.md).  _recover_harvest regenerates the
+    lost tick's events on the host bit-exactly and demotes the calc chain
+    exactly like a launch-time failure."""
+    faults.install("aoi.fetch:fail@3")
+    engines, handles = _cpu_vs_tpu()
+    out, _ = _drive(engines, handles, 256, 8)
+    _assert_same(out)
+    st = handles["tpu"].bucket.stats
+    assert st["calc_level"] == 1, st
+    assert st["rebuilds"] >= 1 and st["host_ticks"] >= 1, st
+
+
+def test_harvest_phase_oom_keeps_calculator():
+    """OOM at the harvest fetch is a memory fault, not a kernel bug: the
+    bucket rebuilds device residency but stays on the pallas path."""
+    faults.install("aoi.fetch:oom@3")
+    engines, handles = _cpu_vs_tpu()
+    out, _ = _drive(engines, handles, 256, 8)
+    _assert_same(out)
+    st = handles["tpu"].bucket.stats
+    assert st["rebuilds"] >= 1, st
+    assert st["calc_level"] == 0, st
+
+
 def test_mesh_fault_parity():
     from goworld_tpu.parallel import SpaceMesh, multichip_devices
 

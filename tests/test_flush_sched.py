@@ -1,0 +1,312 @@
+"""Split-phase flush scheduler: issue-all-then-harvest across AOI buckets.
+
+The contract under test (docs/perf.md, engine/aoi.AOIEngine.flush):
+
+* ``flush()`` dispatches EVERY bucket (host pack + delta diff + H2D
+  enqueue + kernel enqueue) before harvesting the first, under the
+  "aoi.dispatch" / "aoi.harvest" spans; ``flush_sched=False`` forces the
+  sequential baseline (dispatch AND harvest per bucket) through the SAME
+  per-bucket methods;
+* the per-space enter/leave stream is bit-identical between the two
+  modes, across all three bucket tiers, with and without
+  ``pipeline=True`` -- the overlap must never reorder events;
+* faults that surface at harvest time -- the async-dispatch reality: a
+  kernel error materializes at the blocking fetch, not at enqueue --
+  recover with the same parity guarantees as dispatch-time faults
+  (``_recover_harvest`` regenerates the lost tick's events on the host).
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu import faults, telemetry
+from goworld_tpu.engine.aoi import AOIEngine
+from goworld_tpu.telemetry import trace
+
+from test_aoi_delta import _pad, _scene, _sparse_step
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+CAPS = (256, 512)  # two capacities -> two buckets for the scheduler
+
+
+def _engines(**tpu_kwargs):
+    """cpu oracle + scheduler-on + forced-sequential engines, each holding
+    one space per capacity in CAPS (>= 2 device buckets to overlap)."""
+    engines = {
+        # the oracle runs sequential so "aoi.dispatch"/"aoi.harvest" spans
+        # in the span tests come from the scheduler engine alone
+        "cpu": AOIEngine(default_backend="cpu", flush_sched=False),
+        "sched": AOIEngine(default_backend="tpu", flush_sched=True,
+                           **tpu_kwargs),
+        "seq": AOIEngine(default_backend="tpu", flush_sched=False,
+                         **tpu_kwargs),
+    }
+    handles = {k: [e.create_space(c) for c in CAPS]
+               for k, e in engines.items()}
+    return engines, handles
+
+
+def _drive_multi(engines, handles, ticks, seed=7, n=180):
+    """One identical sparse walk per capacity, submitted to every engine;
+    returns out[key][tick] = [(enter, leave) per space]."""
+    scenes = [list(_scene(seed + i, cap, n)) for i, cap in enumerate(CAPS)]
+    out = {k: [] for k in engines}
+    for _t in range(ticks):
+        for (rng, xs, zs, _rr, _act) in scenes:
+            _sparse_step(rng, xs, zs)
+        for k, e in engines.items():
+            for (rng, xs, zs, rr, act), h, cap in zip(
+                    scenes, handles[k], CAPS):
+                e.submit(h, _pad(xs, cap), _pad(zs, cap), _pad(rr, cap),
+                         act.copy())
+            e.flush()
+            out[k].append([e.take_events(h) for h in handles[k]])
+    return out
+
+
+def _assert_multi_same(out, ref="cpu", shift=0, keys=None):
+    for k in (keys if keys is not None else [x for x in out if x != ref]):
+        for t in range(len(out[ref]) - shift):
+            for si in range(len(CAPS)):
+                re_, rl = out[ref][t][si]
+                pe, pl = out[k][t + shift][si]
+                np.testing.assert_array_equal(
+                    re_, pe, err_msg=f"{k} space {si} enter tick {t}")
+                np.testing.assert_array_equal(
+                    rl, pl, err_msg=f"{k} space {si} leave tick {t}")
+
+
+def _drain_trailing(engines, handles, out, keys):
+    """Pipelined engines hold the last tick inflight: flush once more and
+    append the delivery so shift=1 comparison sees every tick."""
+    for k in keys:
+        engines[k].flush()
+        out[k].append([engines[k].take_events(h) for h in handles[k]])
+
+
+# -- parity: scheduler vs sequential vs oracle -------------------------------
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_sched_parity_two_buckets(pipeline):
+    """The tentpole acceptance: issue-all-then-harvest across two TPU
+    buckets is bit-identical to the forced-sequential baseline and to the
+    CPU oracle, pipelined or not."""
+    engines, handles = _engines(pipeline=pipeline)
+    out = _drive_multi(engines, handles, 8)
+    if pipeline:
+        _drain_trailing(engines, handles, out, ("sched", "seq"))
+        for k in ("sched", "seq"):
+            first = out[k][0]
+            assert all(len(e) == 0 and len(l) == 0 for e, l in first), \
+                "pipelined tick 0 delivers nothing"
+        _assert_multi_same(out, shift=1, keys=("sched", "seq"))
+    else:
+        _assert_multi_same(out)
+
+
+def test_sched_spans_dispatch_before_harvest():
+    """Every flush emits one "aoi.dispatch" span covering all bucket
+    dispatches and one "aoi.harvest" span after it -- the span pair the
+    flush_sched_smoke overlap report and docs/perf.md are built on."""
+    engines, handles = _engines()
+    telemetry.enable()
+    trace.reset()
+    try:
+        _drive_multi(engines, handles, 3)
+        spans = [(nm, t0, t1) for nm, _tid, t0, t1 in trace.spans()
+                 if nm in ("aoi.dispatch", "aoi.harvest")]
+    finally:
+        telemetry.disable()
+    dispatches = [s for s in spans if s[0] == "aoi.dispatch"]
+    harvests = [s for s in spans if s[0] == "aoi.harvest"]
+    # one pair per flush of the scheduler engine (the seq engine emits none)
+    assert len(dispatches) == len(harvests) == 3
+    for (_d, d0, d1), (_h, h0, h1) in zip(dispatches, harvests):
+        assert d1 <= h0, "all dispatches precede the first harvest fetch"
+
+
+def test_sequential_engine_emits_no_scheduler_spans():
+    engines, handles = _engines()
+    del engines["sched"], handles["sched"]
+    telemetry.enable()
+    trace.reset()
+    try:
+        _drive_multi(engines, handles, 2)
+        names = {nm for nm, *_ in trace.spans()}
+    finally:
+        telemetry.disable()
+    assert "aoi.dispatch" not in names and "aoi.harvest" not in names
+
+
+# -- faults firing during the scheduled flush --------------------------------
+
+def test_sched_dispatch_faults_multi_bucket_parity():
+    """aoi.h2d OOM and aoi.kernel failure land inside the scheduler's
+    dispatch sweep while the OTHER bucket holds undispatched/unharvested
+    work; both modes recover to the oracle stream bit-for-bit."""
+    results = {}
+    for mode in ("sched", "seq"):
+        faults.clear()
+        faults.install("seed=7;aoi.h2d:oom@3;aoi.kernel:fail@5")
+        engines, handles = _engines()
+        keep = {"cpu": engines["cpu"], mode: engines[mode]}
+        hkeep = {"cpu": handles["cpu"], mode: handles[mode]}
+        out = _drive_multi(keep, hkeep, 8)
+        _assert_multi_same(out, keys=(mode,))
+        st = [h.bucket.stats for h in handles[mode]]
+        assert sum(s["rebuilds"] for s in st) >= 1, st
+        results[mode] = out[mode]
+    for t, (a, b) in enumerate(zip(results["sched"], results["seq"])):
+        for (ae, al), (be, bl) in zip(a, b):
+            np.testing.assert_array_equal(ae, be, err_msg=f"tick {t}")
+            np.testing.assert_array_equal(al, bl, err_msg=f"tick {t}")
+
+
+def test_harvest_kernel_fault_demotes_and_recovers():
+    """aoi.fetch:fail fires INSIDE _harvest -- the genuine harvest-time
+    kernel fault (async dispatch surfaced the error at the blocking
+    fetch).  _recover_harvest regenerates the tick's events on the host,
+    bit-exact, and demotes the calc chain exactly like a launch fault.
+
+    Occurrence math: the seam counter is global and each tick harvests
+    sched.A, sched.B, seq.A, seq.B in order (the oracle never hits device
+    seams), so occurrence 5 = the SCHED engine's first bucket, tick 2."""
+    faults.install("aoi.fetch:fail@5")
+    engines, handles = _engines()
+    out = _drive_multi(engines, handles, 8)
+    _assert_multi_same(out)
+    st = [h.bucket.stats for h in handles["sched"]]
+    assert any(s["calc_level"] == 1 for s in st), st
+    assert sum(s["rebuilds"] for s in st) >= 1, st
+    assert sum(s["host_ticks"] for s in st) >= 1, st
+
+
+def test_harvest_oom_rebuilds_without_demotion():
+    """aoi.fetch:oom at harvest is a memory fault, not a kernel bug: the
+    bucket rebuilds device state but keeps the pallas calculator.
+    (occurrence 5 = the sched engine's first bucket -- see above)"""
+    faults.install("aoi.fetch:oom@5")
+    engines, handles = _engines()
+    out = _drive_multi(engines, handles, 8)
+    _assert_multi_same(out)
+    st = [h.bucket.stats for h in handles["sched"]]
+    assert sum(s["rebuilds"] for s in st) >= 1, st
+    assert all(s["calc_level"] == 0 for s in st), st
+
+
+def test_harvest_fault_pipelined_converges():
+    """Pipelined harvest-time recovery coalesces the faulted tick with the
+    one already dispatched after it (docs/robustness.md): per-tick streams
+    may merge, but the net interest state must converge to the oracle's."""
+    faults.install("aoi.fetch:fail@4")
+    engines, handles = _engines(pipeline=True)
+    _drive_multi(engines, handles, 8)
+    for k in ("cpu", "sched", "seq"):
+        for h in handles[k]:
+            h.bucket.drain()
+    for si in range(len(CAPS)):
+        ref = handles["cpu"][si].bucket.peek_words(handles["cpu"][si].slot)
+        for k in ("sched", "seq"):
+            h = handles[k][si]
+            np.testing.assert_array_equal(
+                ref, h.bucket.peek_words(h.slot),
+                err_msg=f"{k} space {si} final interest words")
+
+
+def test_poisoned_scalars_at_harvest_full_diff():
+    """The poisoned-scalar path (range-validated at decode, full-diff
+    fallback) still works when decode runs in the harvest phase.
+    (occurrence 5 = the sched engine's first bucket -- see above)"""
+    faults.install("aoi.scalars:poison@5")
+    engines, handles = _engines()
+    out = _drive_multi(engines, handles, 8)
+    _assert_multi_same(out)
+    st = [h.bucket.stats for h in handles["sched"]]
+    assert sum(s["poisoned"] for s in st) >= 1, st
+    assert all(s["calc_level"] == 0 for s in st), st
+
+
+# -- the other two tiers ------------------------------------------------------
+
+def _mesh_or_skip(n=8):
+    from goworld_tpu.parallel import SpaceMesh, multichip_devices
+
+    devs = multichip_devices(n)
+    if len(devs) < n:
+        pytest.skip(f"needs {n} (virtual) devices")
+    return SpaceMesh(devs)
+
+
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_mesh_sched_parity(pipeline):
+    mesh = _mesh_or_skip()
+    engines, handles = _engines(mesh=mesh, pipeline=pipeline)
+    assert type(handles["sched"][0].bucket).__name__ == "_MeshTPUBucket"
+    out = _drive_multi(engines, handles, 6)
+    if pipeline:
+        _drain_trailing(engines, handles, out, ("sched", "seq"))
+        _assert_multi_same(out, shift=1, keys=("sched", "seq"))
+    else:
+        _assert_multi_same(out)
+
+
+def test_mesh_harvest_fault_parity():
+    mesh = _mesh_or_skip()
+    # occurrence 5 = the sched engine's first bucket (see the occurrence
+    # math above)
+    faults.install("aoi.fetch:fail@5")
+    engines, handles = _engines(mesh=mesh)
+    out = _drive_multi(engines, handles, 6)
+    _assert_multi_same(out)
+    st = [h.bucket.stats for h in handles["sched"]]
+    assert any(s["calc_level"] == 1 for s in st), st
+    assert sum(s["host_ticks"] for s in st) >= 1, st
+
+
+def _rowshard_engines(mesh, cap=2048, **kw):
+    engines = {
+        "cpu": AOIEngine(default_backend="cpu"),
+        "sched": AOIEngine(default_backend="tpu", mesh=mesh,
+                           rowshard_min_capacity=cap, flush_sched=True, **kw),
+        "seq": AOIEngine(default_backend="tpu", mesh=mesh,
+                         rowshard_min_capacity=cap, flush_sched=False, **kw),
+    }
+    handles = {k: e.create_space(cap) for k, e in engines.items()}
+    assert type(handles["sched"].bucket).__name__ == "_RowShardTPUBucket"
+    return engines, handles
+
+
+def _drive_rowshard(engines, handles, cap, ticks, n=300):
+    rng, xs, zs, rr, act = _scene(13, cap, n)
+    out = {k: [] for k in engines}
+    for _t in range(ticks):
+        _sparse_step(rng, xs, zs)
+        for k, e in engines.items():
+            e.submit(handles[k], _pad(xs, cap), _pad(zs, cap), _pad(rr, cap),
+                     act.copy())
+            e.flush()
+            out[k].append(e.take_events(handles[k]))
+    return out
+
+
+def test_rowshard_sched_parity_and_harvest_fault():
+    mesh = _mesh_or_skip()
+    # one bucket per engine here: per tick the seam counts sched then seq,
+    # so occurrence 3 = the sched engine at tick 2
+    faults.install("aoi.fetch:fail@3")
+    cap = 2048
+    engines, handles = _rowshard_engines(mesh)
+    out = _drive_rowshard(engines, handles, cap, 5)
+    for k in ("sched", "seq"):
+        for t, ((oe, ol), (pe, pl)) in enumerate(zip(out["cpu"], out[k])):
+            np.testing.assert_array_equal(oe, pe, err_msg=f"{k} enter {t}")
+            np.testing.assert_array_equal(ol, pl, err_msg=f"{k} leave {t}")
+    st = handles["sched"].bucket.stats
+    assert st["fallbacks"] >= 1 and st["host_ticks"] >= 1, st

@@ -17,7 +17,8 @@ import textwrap
 from pathlib import Path
 
 from goworld_tpu.analysis import coverage, determinism, dtypes, \
-    fault_seams, h2d_staging, host_sync, telemetry_rule, wire_protocol
+    fault_seams, flush_phase, h2d_staging, host_sync, telemetry_rule, \
+    wire_protocol
 from goworld_tpu.analysis.__main__ import main as gwlint_main
 from goworld_tpu.analysis.core import run
 
@@ -361,6 +362,118 @@ def test_h2d_staging_covers_flush_helpers(tmp_path):
         ("engine/aoi_mesh.py", _ln(STAGE_HELPER, "jnp.asarray(self._hx)")),
     }
     # _stage_inputs is the seam itself: never flagged
+
+
+STAGE_DISPATCH = """\
+    import jax.numpy as jnp
+
+    class Bucket:
+        def dispatch(self):
+            return self._dispatch_device()
+
+        def _dispatch_device(self):
+            return jnp.asarray(self._hx)
+"""
+
+
+def test_h2d_staging_covers_dispatch_helpers(tmp_path):
+    """The split-phase scheduler renamed the flush bodies _dispatch_device;
+    shadow uploads there stay in scope."""
+    _mk(tmp_path, {"engine/aoi.py": STAGE_DISPATCH})
+    findings, _ = _run(tmp_path, [h2d_staging.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("engine/aoi.py", _ln(STAGE_DISPATCH, "jnp.asarray(self._hx)")),
+    }
+
+
+# -- flush-phase --------------------------------------------------------------
+
+DISPATCH = """\
+    import numpy as np
+
+    def helper(v):
+        return np.asarray(v)
+
+    class _Bucket:
+        def _shared(self, v):
+            return v.item()
+
+    class Bucket(_Bucket):
+        def dispatch(self):
+            if self._sched is not None:
+                self.harvest()  # gwlint: allow[flush-phase] -- fixture re-entrant guard
+            self._enqueue()
+            return helper(self.prev)
+
+        def _enqueue(self):
+            a = self._shared(self.prev)
+            b = self._recover()
+            return a, b
+
+        def _recover(self):  # gwlint: allow[flush-phase] -- fixture recovery boundary
+            return np.asarray(self.prev)
+
+        def harvest(self):
+            return np.asarray(self.prev)
+
+        def flush(self):
+            return float(self.prev)
+"""
+
+
+def test_flush_phase_walks_call_graph_from_dispatch(tmp_path):
+    """Syncs REACHABLE from dispatch() are flagged wherever they live --
+    a module helper, a base-class method -- while declared boundaries
+    (the allow[] on the re-entrant harvest call and on the recovery def)
+    stop the traversal, and functions dispatch never reaches (flush,
+    harvest) are out of scope."""
+    _mk(tmp_path, {"engine/aoi.py": DISPATCH})
+    findings, _ = _run(tmp_path, [flush_phase.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("engine/aoi.py", _ln(DISPATCH, "np.asarray(v)")),
+        ("engine/aoi.py", _ln(DISPATCH, "v.item()")),
+    }
+    assert all(f.rule == "flush-phase" for f in findings)
+    assert any("Bucket.dispatch" in f.message and "helper" in f.message
+               for f in findings)
+
+
+DISPATCH_BASE = """\
+    import numpy as np
+
+    class _Bucket:
+        def _stage(self):
+            return np.asarray(self.prev)
+"""
+
+DISPATCH_SUB = """\
+    from .aoi import _Bucket
+
+    class MeshBucket(_Bucket):
+        def dispatch(self):
+            return self._stage()
+"""
+
+
+def test_flush_phase_resolves_bases_across_files(tmp_path):
+    """mesh/rowshard inherit helpers from engine/aoi.py: a sync in the
+    base is flagged when a subclass dispatch reaches it."""
+    _mk(tmp_path, {"engine/aoi.py": DISPATCH_BASE,
+                   "engine/aoi_mesh.py": DISPATCH_SUB})
+    findings, _ = _run(tmp_path, [flush_phase.check])
+    got = {(f.path, f.line, "MeshBucket.dispatch" in f.message)
+           for f in findings}
+    assert got == {
+        ("engine/aoi.py", _ln(DISPATCH_BASE, "np.asarray(self.prev)"), True),
+    }
+
+
+def test_flush_phase_out_of_scope_files_untouched(tmp_path):
+    _mk(tmp_path, {"ops/x.py": DISPATCH})
+    findings, _ = _run(tmp_path, [flush_phase.check])
+    assert findings == []
 
 
 # -- fault-seam-coverage -----------------------------------------------------
