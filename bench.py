@@ -141,6 +141,10 @@ class Config:
         # use this: their event streams are wire-bound on the dev tunnel,
         # which measures the weather, not the framework.
         self.cadence = cadence
+        # kernel-level configs time the Pallas kernel itself and need a real
+        # accelerator; the engine config drives the host path and runs
+        # anywhere (main() skips kernel-level configs on chip-less hosts)
+        self.kernel_level = name != "engine"
         # "dense": brute-force C^2 pallas kernel.  "grid": x-ordered block
         # culling (ops/aoi_grid) -- the windowed-work variant for large C;
         # bit-exact (the parity fold covers it), diffed by recomputing the
@@ -1121,7 +1125,7 @@ def _timed(fn):
 
 def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
                  movers_frac=None, delta_staging=True, flush_sched=True,
-                 cap_mix=False):
+                 cap_mix=False, aoi_emit="auto"):
     """Engine-level number: ``Runtime.tick`` end-to-end.
 
     Movement drive:
@@ -1163,6 +1167,12 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     vs. the sequential run's per-bucket kernel+fetch+emit sum, with
     bit-identical ``parity_checksum`` (a CRC fold over every delivered
     enter/leave pair array, in delivery order).
+
+    ``aoi_emit`` selects the event decode/fan-out path (docs/perf.md emit
+    paths): ``auto`` (device-resident triples decode + fastest available
+    fan-out, the default) vs ``host`` (the original word-stream oracle).
+    The A/B pair's ``parity_checksum`` must be bit-identical -- that fold
+    IS the emit-path correctness artifact.
     """
     import jax
 
@@ -1190,7 +1200,7 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
 
     rt = Runtime(aoi_backend=backend, aoi_pipeline=pipeline,
                  aoi_delta_staging=delta_staging,
-                 aoi_flush_sched=flush_sched)
+                 aoi_flush_sched=flush_sched, aoi_emit=aoi_emit)
     rt.entities.register(BenchScene)
     rt.entities.register(BenchMob)
     rt.entities.register(BenchWatcher)
@@ -1359,6 +1369,8 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
         span_s[_name] = span_s.get(_name, 0.0) + (_s1 - _s0)
     telemetry.disable()
     kind = backend + ("+pipeline" if pipeline else "")
+    if aoi_emit != "auto":
+        kind += f"+emit={aoi_emit}"
     drive = "bulk move_entities" if bulk else "per-entity set_position"
     if cap_mix:
         config = "engine_sched"
@@ -1419,7 +1431,7 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
         ph: round(span_s.get(nm, 0.0) / total_ticks * 1e3, 3)
         for ph, nm in (("stage", "aoi.stage"), ("kernel", "aoi.kernel"),
                        ("diff", "aoi.diff"), ("fetch", "aoi.fetch"),
-                       ("emit", "aoi.emit"),
+                       ("decode", "aoi.decode"), ("emit", "aoi.emit"),
                        ("dispatch", "aoi.dispatch"),
                        ("harvest", "aoi.harvest"))
     }
@@ -1432,6 +1444,14 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     # same hex or the overlap changed observable event order
     out["flush_sched"] = flush_sched
     out["parity_checksum"] = f"{_crc['v']:08x}"
+    # emit-path bookkeeping (docs/perf.md emit paths): which path actually
+    # ran (worst live level across buckets) and how many compact decodes
+    # overflowed into the counted full-diff fallback
+    out["aoi_emit"] = aoi_emit
+    _levels = [b.stats["emit_path"] for b in rt.aoi._buckets.values()
+               if getattr(b, "stats", None) and "emit_path" in b.stats]
+    if _levels:
+        out["aoi_emit_path"] = max(_levels)
     if cap_mix:
         out["n_buckets"] = len(rt.aoi._buckets)
     stats1 = stats_snapshot()
@@ -1447,6 +1467,9 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
             / total_ticks)
         out["aoi_delta_hit_rate"] = round(
             dflush / max(dflush + fflush, 1), 3)
+        if "decode_overflow" in stats1:
+            out["aoi_decode_overflow"] = (stats1["decode_overflow"]
+                                          - stats0.get("decode_overflow", 0))
     return out
 
 
@@ -1561,6 +1584,13 @@ def run_config(cfg, companion=False, cpu_cached=None):
             None if tpu["device_marginal_degenerate"] else round(
                 pair_tests / max(tpu["device_ms_per_tick"], 1e-3) * 1e3)),
     }
+    if not tpu["device_marginal_degenerate"]:
+        # the tentpole's scoreboard number (docs/perf.md emit paths): how
+        # much slower the harvested wall tick runs than the chip's marginal
+        # tick.  The device-resident decode + native fan-out exist to hold
+        # this <= 2 on the uniform-churn e2e configs.
+        out["wall_vs_device_ratio"] = round(
+            tpu["ms_per_tick"] / max(tpu["device_ms_per_tick"], 1e-3), 2)
     for k in ("mode", "parity_checksum", "parity_ok",
               "device_cadence_moves_per_sec", "device_cadence_ms_per_tick",
               "host_loop_ms_per_tick", "stream_bytes_per_tick",
@@ -1620,12 +1650,29 @@ def main():
         print(json.dumps(out), flush=True)
         lines.append(out)
 
-    try:
-        emit(bench_sentinel())
-    except Exception as e:  # the sentinel must never block the matrix
-        print(f"# sentinel failed: {e!r}", file=sys.stderr, flush=True)
+    # chip-less degradation: the sentinel and the kernel-level configs
+    # measure chip/tunnel behavior through the Pallas kernel, which on a
+    # CPU container runs in interpret mode (hours per config -- BENCH_r05's
+    # first re-run attempt hung here).  Skip them with a note so a
+    # no-accelerator `python bench.py` still lands a clean rc-0 artifact
+    # from the host-path configs.
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        try:
+            emit(bench_sentinel())
+        except Exception as e:  # the sentinel must never block the matrix
+            print(f"# sentinel failed: {e!r}", file=sys.stderr, flush=True)
+    else:
+        print("# sentinel skipped: no accelerator (it measures chip/tunnel "
+              "environment drift)", file=sys.stderr, flush=True)
     headline = None
     for cfg in matrix:
+        if not on_tpu and getattr(cfg, "kernel_level", False):
+            print(f"# skipping {cfg.name}: kernel-level config needs an "
+                  "accelerator", file=sys.stderr, flush=True)
+            continue
         if not cfg.headline and time.perf_counter() - t0 > TIME_BUDGET_S:
             print(f"# skipping {cfg.name}: time budget exceeded",
                   file=sys.stderr, flush=True)
@@ -1650,6 +1697,11 @@ def main():
                 # device-cadence engine number: same pipelined engine,
                 # movement arriving through the bulk client-sync path
                 emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True))
+                # emit-path A/B (docs/perf.md emit paths): the same walk
+                # through the host word-stream oracle -- parity_checksum
+                # must be bit-identical to the default (triples) line above
+                emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True,
+                                  aoi_emit="host"))
                 # all-plain production shape (NPC farm): the space
                 # unsubscribes from the event stream -- per-tick fetch is
                 # scalars-only
@@ -1727,9 +1779,14 @@ def main():
                          ("e2e_wire_ceiling_moves_per_sec", "wire_ceil"),
                          ("wire_MBps", "wire_MBps"),
                          ("auto_backend", "auto"),
+                         ("wall_vs_device_ratio", "wall_dev"),
+                         ("aoi_emit", "emit"),
+                         ("aoi_emit_path", "emit_path"),
+                         ("aoi_decode_overflow", "dec_ovf"),
                          ("drive_ms", "drive_ms"),
                          ("aoi_stage_ms", "stage_ms"),
                          ("aoi_fetch_ms", "fetch_ms"),
+                         ("aoi_emit_ms", "emit_ms"),
                          ("aoi_calc_ms", "calc_ms"),
                          ("aoi_h2d_bytes_per_tick", "h2d_B"),
                          ("aoi_delta_hit_rate", "delta_hit"),

@@ -16,8 +16,15 @@ Boundaries are explicit: a call line or callee ``def`` line carrying
 idiom for the re-entrant harvest guard and the fault-recovery paths,
 where the device is gone and host sync is the point).
 
+The same walk guards the EMIT layer (docs/perf.md emit paths): harvest's
+publish/fan-out helpers (``_publish*``/``_emit*`` in the bucket tiers,
+plus every module function of ops/aoi_emit.py) run on already-fetched
+host arrays, so a blocking device fetch reached from one re-serializes
+the harvest drain the split-phase scheduler just overlapped.
+
 Scope: the bucket modules (engine/aoi.py, engine/aoi_mesh.py,
-engine/aoi_rowshard.py).
+engine/aoi_rowshard.py) and the emit layer (ops/aoi_emit.py, emit
+entry points only).
 """
 
 from __future__ import annotations
@@ -30,6 +37,15 @@ from .host_sync import _SYNC_ATTRS, _SYNC_CALLS
 RULE = "flush-phase"
 
 SCOPE = ("engine/aoi.py", "engine/aoi_mesh.py", "engine/aoi_rowshard.py")
+# the emit layer: walked as its own entry-point set (harvest publish
+# helpers must not re-enter blocking device fetches)
+EMIT_SCOPE = SCOPE + ("ops/aoi_emit.py",)
+
+_DISPATCH_REASON = ("dispatch() must be pure enqueue (docs/perf.md: the "
+                    "scheduler overlap dies at the first blocking fetch)")
+_EMIT_REASON = ("harvest emit helpers run on already-fetched arrays and "
+                "must not re-enter a blocking device fetch (docs/perf.md "
+                "emit paths)")
 
 
 def _sync_msg(node: ast.Call) -> str | None:
@@ -103,22 +119,41 @@ def _has_allow(sf: SourceFile, line: int) -> bool:
 
 
 def check(ctx: Context):
-    files = ctx.files_matching(*SCOPE)
+    files = ctx.files_matching(*EMIT_SCOPE)
     graph = _Graph(files)
     for sf in files:
+        emit_layer = sf.rel.endswith("ops/aoi_emit.py")
+        if emit_layer:
+            # every module function of the emit layer is an entry point
+            for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
+                yield from _walk(graph, "", name, fn, fsf, _EMIT_REASON)
+            continue
         for cls in sf.tree.body:
             if not isinstance(cls, ast.ClassDef):
                 continue
-            entry = graph.classes.get(cls.name, ([], {}))[1].get("dispatch")
-            if entry is None or entry[1] is not sf:
-                continue  # inherited default (host-only tiers) is inline-ok
-            yield from _walk(graph, cls.name, "dispatch", *entry)
+            methods = graph.classes.get(cls.name, ([], {}))[1]
+            entry = methods.get("dispatch")
+            if entry is not None and entry[1] is sf:
+                # inherited default (host-only tiers) is inline-ok
+                yield from _walk(graph, cls.name, "dispatch", *entry,
+                                 _DISPATCH_REASON)
+            for name, m_entry in methods.items():
+                if m_entry[1] is sf and (name.startswith("_publish")
+                                         or name.startswith("_emit")):
+                    yield from _walk(graph, cls.name, name, *m_entry,
+                                     _EMIT_REASON)
+        # module-level emit helpers (shared across the bucket tiers)
+        for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
+            if name.startswith("_emit"):
+                yield from _walk(graph, "", name, fn, fsf, _EMIT_REASON)
 
 
-def _walk(graph: _Graph, cls: str, entry_name: str, entry_node, entry_sf):
-    # BFS over (function node, its file, display path from dispatch)
+def _walk(graph: _Graph, cls: str, entry_name: str, entry_node, entry_sf,
+          reason: str = _DISPATCH_REASON):
+    # BFS over (function node, its file, display path from the entry)
     visited: set[tuple[str, int]] = set()
-    queue = [(entry_node, entry_sf, f"{cls}.{entry_name}")]
+    display = f"{cls}.{entry_name}" if cls else entry_name
+    queue = [(entry_node, entry_sf, display)]
     while queue:
         fn, sf, path = queue.pop(0)
         key = (sf.rel, fn.lineno)
@@ -134,10 +169,8 @@ def _walk(graph: _Graph, cls: str, entry_name: str, entry_node, entry_sf):
             if msg is not None:
                 yield Finding(
                     RULE, sf.rel, node.lineno, node.col_offset,
-                    f"{msg}, reachable from {path} -- dispatch() must be "
-                    "pure enqueue (docs/perf.md: the scheduler overlap "
-                    "dies at the first blocking fetch); move it into "
-                    "harvest() or mark the boundary "
+                    f"{msg}, reachable from {path} -- {reason}; move it "
+                    "out of the walked phase or mark the boundary "
                     "'# gwlint: allow[flush-phase] -- <why>'")
                 continue
             if _has_allow(sf, node.lineno):

@@ -36,6 +36,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .. import faults, telemetry
+from ..ops import aoi_emit as AE
 from ..ops import aoi_predicate as P
 from ..ops.aoi_oracle import CPUAOIOracle
 from ..telemetry import trace as _T
@@ -46,6 +47,7 @@ from ..ops import events as EV
 # reused after release.
 
 _fused_impl = None  # built lazily: jax must not load in cpu-only processes
+_fused_tri_impl = None
 _clear_impl = None
 
 
@@ -81,6 +83,11 @@ def _batched_clear(prev_all, row_slots, row_ents, col_slots, col_words,
 _LANES = 128
 _MAX_GAPS = 2048    # escaped chunk-index deltas per flush
 _MAX_EXC = 32768    # exception triples (tail + multi-bit words) per flush
+# triples-path extraction cap ceiling: the [max_triples, 32] bit matrix
+# inside extract_triples is the shape driver (~32 MB of int32 at 2^18), so
+# growth stops here and larger ticks permanently take the counted
+# full-grid fallback (decode_overflow)
+_TRI_MAX = 1 << 18
 
 
 def _device_fault(e: BaseException) -> bool:
@@ -136,13 +143,52 @@ def _packed_predicate(x, z, r, act, block: int = 2048) -> np.ndarray:  # gwlint:
     return out
 
 
-def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:  # gwlint: allow[host-sync] -- host numpy helper; operates on np.unique output, never device values
+def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:
     """(space_row, i, j) triples -> {space_row: (i, j) pairs}."""
     out: dict[int, np.ndarray] = {}
     if len(tri):
-        for s in np.unique(tri[:, 0]):
-            out[int(s)] = tri[tri[:, 0] == s][:, 1:]
+        for s in np.unique(tri[:, 0]).tolist():
+            out[s] = tri[tri[:, 0] == s][:, 1:]
     return out
+
+
+def _demote_emit(bucket, e: BaseException) -> None:
+    """``aoi.emit`` seam fault: the faulted tick's events fall back to the
+    host decode (pure numpy on arrays the harvest already fetched, so the
+    fallback is bit-exact), and the bucket sticks to the host emit path for
+    every later tick (docs/robustness.md emit fallback chain;
+    ``reset_emit_path`` re-arms)."""
+    from ..utils import gwlog
+
+    bucket._emit = "host"
+    bucket.stats["emit_path"] = AE.EMIT_LEVEL["host"]
+    gwlog.logger("gw.aoi").warning(
+        "AOI bucket (cap %d) emit fan-out fault: %s -- demoting to the "
+        "host decode emit path", bucket.capacity, e)
+
+
+def _emit_expand(bucket, chg_vals, ent_vals, gidx, s_n: int):
+    """Classified word stream -> sorted (enter, leave) triples through the
+    bucket's emit path (docs/perf.md emit paths): C++ bit expansion when
+    the bucket runs emit="native", the numpy host expansion otherwise (for
+    word streams "vector" IS the host expansion -- the vector/native split
+    only diverges on the single-chip triples path).  The native attempt
+    sits behind the ``aoi.emit`` fault seam; any failure is handled HERE --
+    never propagated to harvest's device-fault recovery -- by demoting the
+    bucket and expanding the same stream on host, bit-exactly.
+    Harvest-phase numpy on already-fetched arrays throughout (the gwlint
+    flush-phase rule walks emit helpers)."""
+    if bucket._emit == "native" and len(chg_vals):
+        try:
+            faults.check("aoi.emit")
+            return AE.expand_words_native(chg_vals, ent_vals, gidx,
+                                          bucket.capacity)
+        except Exception as e:
+            if not (_device_fault(e) or isinstance(e, RuntimeError)):
+                raise
+            _demote_emit(bucket, e)
+    return EV.expand_classified_host(chg_vals, ent_vals, gidx,
+                                     bucket.capacity, s_n)
 
 
 def _fused_bucket_step(prev_all, *args):
@@ -228,6 +274,58 @@ def _fused_bucket_step(prev_all, *args):
     return _fused_impl(prev_all, *args)
 
 
+def _fused_bucket_step_tri(prev_all, *args):
+    """Triples-mode bucket flush (docs/perf.md emit paths): same gather /
+    fused kernel / scatter prologue as :func:`_fused_bucket_step`, but the
+    diff compacts straight into fixed-capacity (observer, observed, kind)
+    triples ON DEVICE (ops/events.py extract_triples) -- harvest then
+    fetches the compact triple buffer plus ONE count scalar, and the host
+    never unpacks a word again on the steady path.  The raw ``new``/``chg``
+    grids still ride donated scratch for the counted-overflow and
+    poisoned-scalar recoveries (prev_all is donated, so the diff would
+    otherwise be unrecoverable).
+
+    ``args`` = (new_buf, chg_buf, tri_buf, slot_idx, x_all, z_all, r_all,
+    act_all, sub_all, max_triples, platform).
+    """
+    global _fused_tri_impl
+    if _fused_tri_impl is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.aoi_dense import aoi_step_chg
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("max_triples", "platform"),
+            donate_argnums=(0, 1, 2, 3))
+        def impl(prev_all, new_buf, chg_buf, tri_buf, slot_idx, x_all,
+                 z_all, r_all, act_all, sub_all, max_triples,
+                 platform=None):
+            prev_rows = prev_all[slot_idx]
+            x = x_all[slot_idx]
+            z = z_all[slot_idx]
+            r = r_all[slot_idx]
+            act = act_all[slot_idx]
+            sub = sub_all[slot_idx]
+            new, chg = aoi_step_chg(x, z, r, act, prev_rows,
+                                    platform=platform)
+            prev_all = prev_all.at[slot_idx].set(new)
+            chg = jnp.where(sub[:, None, None], chg, jnp.uint32(0))
+            tri, count = EV.extract_triples(chg, new, chg.shape[1],
+                                            max_triples)
+            new_buf = new_buf.at[:].set(new)
+            chg_buf = chg_buf.at[:].set(chg)
+            tri_buf = tri_buf.at[:].set(tri)
+            return (prev_all, new_buf, chg_buf, tri_buf,
+                    count.reshape(1))
+
+        _fused_tri_impl = impl
+    return _fused_tri_impl(prev_all, *args)
+
+
 class _CapDecay:
     """Windowed decay of adaptive extraction caps, shared by the TPU
     buckets (single-chip and mesh).  Growth on overflow is the owner's
@@ -276,6 +374,44 @@ class _CapDecay:
         return None
 
 
+class _TriCapDecay:
+    """Windowed decay of the triples-path extraction cap (the exact
+    _CapDecay story for ``max_triples``: growth on overflow is the owner's
+    job, this proposes post-storm shrinks on a doubling window and reports
+    ``steady`` once the static compile key is final)."""
+
+    def __init__(self, floor: int):
+        self.floor = floor
+        self.peak = 0
+        self.flushes = 0
+        self.refit_at = 8
+        self.steady = False
+
+    def reset_after_growth(self) -> None:
+        self.peak = 0
+        self.flushes = 0
+        self.refit_at = 8
+        self.steady = False
+
+    def observe(self, count: int, cur: int) -> int | None:
+        """Track one flush's triple count; at the window boundary return
+        the shrunk cap to adopt, or None."""
+        self.peak = max(self.peak, count)
+        self.flushes += 1
+        if self.flushes < self.refit_at:
+            return None
+        fit = max(self.floor,
+                  1 << (max(self.peak * 3 // 2, 1) - 1).bit_length())
+        self.peak = 0
+        self.flushes = 0
+        self.refit_at = min(self.refit_at * 2, 128)
+        if fit < cur:
+            self.steady = False  # one more clean window confirms
+            return fit
+        self.steady = True
+        return None
+
+
 @dataclass
 class SpaceAOIHandle:
     backend: str        # resolved (cpu | cpp | tpu)
@@ -303,8 +439,20 @@ class AOIEngine:
                  pipeline: bool = False, delta_staging: bool = True,
                  tpu_min_capacity: int = 4096,
                  rowshard_min_capacity: int = 65536,
-                 flush_sched: bool = True):
+                 flush_sched: bool = True, emit: str = "auto"):
         self.default_backend = default_backend
+        # event emit fan-out path for the device buckets (docs/perf.md):
+        # "auto" = fastest available (native when libgwemit builds, else
+        # vector), "host" = the original per-word host decode kept as the
+        # bit-exact oracle.  Validated here (fail fast at construction) but
+        # RESOLVED lazily at the first tpu bucket -- resolution may shell
+        # out to make, which a cpu-only engine must never pay.
+        if emit != "auto" and emit not in AE.EMIT_MODES:
+            raise ValueError(
+                f"aoi_emit must be one of {('auto',) + AE.EMIT_MODES}, "
+                f"got {emit!r}")
+        self.emit = emit
+        self._emit_resolved: str | None = None
         # sparse delta staging of device-resident tick inputs (see
         # _TPUBucket._stage_inputs); False = full-restage baseline, kept
         # for perf A/B in bench.py
@@ -436,7 +584,8 @@ class AOIEngine:
 
                     bucket = _RowShardTPUBucket(
                         capacity, self.mesh, pipeline=self.pipeline,
-                        delta_staging=self.delta_staging)
+                        delta_staging=self.delta_staging,
+                        emit=self._resolve_emit())
                     self._rowshard_serial += 1
                     key = (f"tpu-rowshard-{self._rowshard_serial}", capacity)
                 elif self.mesh is not None:
@@ -444,16 +593,26 @@ class AOIEngine:
 
                     bucket = _MeshTPUBucket(
                         capacity, self.mesh, pipeline=self.pipeline,
-                        delta_staging=self.delta_staging)
+                        delta_staging=self.delta_staging,
+                        emit=self._resolve_emit())
                 else:
                     bucket = _TPUBucket(capacity, pipeline=self.pipeline,
-                                        delta_staging=self.delta_staging)
+                                        delta_staging=self.delta_staging,
+                                        emit=self._resolve_emit())
             else:
                 raise ValueError(f"unknown AOI backend {backend!r}")
             self._buckets[key] = bucket
         slot = bucket.acquire_slot()
         return SpaceAOIHandle(backend, capacity, bucket, slot,
                               requested=requested)
+
+    def _resolve_emit(self) -> str:
+        """Resolve the requested emit mode once (an explicit/auto "native"
+        probes -- and on first use builds -- libgwemit; degrading to
+        "vector" when the toolchain is absent must not flap per bucket)."""
+        if self._emit_resolved is None:
+            self._emit_resolved = AE.resolve_mode(self.emit)
+        return self._emit_resolved
 
     def release_space(self, h: SpaceAOIHandle) -> None:
         if not h.released:
@@ -517,10 +676,15 @@ class AOIEngine:
         stats: dict[str, float] = {}
         perf: dict[str, float] = {}
         calc_level = 0
+        emit_path = 0
         for b in (self._buckets[k] for k in sorted(self._buckets)):
             for k, v in getattr(b, "stats", {}).items():
                 if k == "calc_level":
                     calc_level = max(calc_level, v)
+                elif k == "emit_path":
+                    # like calc_level: the WORST bucket -- one demoted emit
+                    # path should page even among healthy neighbors
+                    emit_path = max(emit_path, v)
                 else:
                     stats[k] = stats.get(k, 0) + v
             for k, v in getattr(b, "perf", {}).items():
@@ -529,7 +693,10 @@ class AOIEngine:
                       "live AOI buckets in this engine"),
                Sample("aoi.calc_level", "gauge", calc_level, lbl,
                       "worst calculator fallback level "
-                      "(0=pallas 1=dense 2=host oracle)")]
+                      "(0=pallas 1=dense 2=host oracle)"),
+               Sample("aoi.emit_path", "gauge", emit_path, lbl,
+                      "worst emit-path fallback level "
+                      "(0=native 1=vector 2=host decode)")]
         for k in sorted(stats):
             out.append(Sample("aoi." + k, "counter", stats[k], lbl,
                               "summed per-bucket AOI stat"))
@@ -640,6 +807,16 @@ class _Bucket:
         Default: subscribed.  Host backends ignore this (their events are a
         free by-product of the sweep); device backends skip the extraction,
         fetch, and decode for opted-out slots."""
+
+    def reset_emit_path(self) -> None:
+        """Re-arm the configured emit path after an ``aoi.emit`` demotion
+        (operator action, like reset_calc_chain -- demotion is sticky so a
+        flapping native layer cannot oscillate).  No-op for host buckets,
+        which have no emit seam."""
+        req = getattr(self, "_emit_requested", None)
+        if req is not None:
+            self._emit = req
+            self.stats["emit_path"] = AE.EMIT_LEVEL[req]
 
     # subclass API
     def _grow_to(self, n_slots: int) -> None:
@@ -757,10 +934,17 @@ class _TPUBucket(_Bucket):
     """
 
     def __init__(self, capacity: int, pipeline: bool = False,
-                 delta_staging: bool = True):
+                 delta_staging: bool = True, emit: str = "vector"):
         super().__init__(capacity)
         self.pipeline = pipeline
         self.delta_staging = delta_staging
+        # emit fan-out path (docs/perf.md): "native"/"vector" run the
+        # device-resident triples decode (_fused_bucket_step_tri) and fan
+        # out through ops/aoi_emit; "host" keeps the classic encoded-stream
+        # fetch + host decode as the bit-exact oracle.  _emit_requested is
+        # what reset_emit_path re-arms after an aoi.emit demotion.
+        self._emit = emit
+        self._emit_requested = emit
         self._inflight = None  # pending dispatch awaiting harvest
         # split-phase flush (docs/perf.md): dispatch() parks what harvest()
         # must do here -- ("inflight",) = drain the inflight record,
@@ -789,6 +973,12 @@ class _TPUBucket(_Bucket):
         self._max_chunks = 4096
         self._kcap = 8
         self._caps = _CapDecay(nd_floor=4096)
+        # triples-path extraction cap (native/vector emit): grows on a
+        # counted overflow up to _TRI_MAX, decays back via _tri
+        self._max_triples = 16384
+        self._tri = _TriCapDecay(floor=16384)
+        # optimistic triple-buffer prefetch rows for the pipelined path
+        self._pred_tri = 2048
         # donated scratch buffers, keyed (s_n, mc, kcap); replaced by each
         # flush's returns (same device memory, in-place)
         self._scratch: dict[tuple, tuple] = {}
@@ -850,19 +1040,30 @@ class _TPUBucket(_Bucket):
         # the durable copy, fallbacks = calculator demotions, host_ticks =
         # ticks computed by the host oracle (recovery or level-2 mode),
         # poisoned = control-scalar corruptions caught by validation.
+        # emit-path additions: decode_overflow = ticks whose compact decode
+        # overflowed its cap and fell back to a counted full recovery;
+        # emit_path = the fan-out level actually in use (0=native 1=vector
+        # 2=host decode), surfaced like calc_level as a max gauge.
         self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
-                      "poisoned": 0, "calc_level": 0}
+                      "poisoned": 0, "calc_level": 0,
+                      "decode_overflow": 0,
+                      "emit_path": AE.EMIT_LEVEL[emit]}
         # phase-attribution counters (seconds, cumulative): stage = host
         # pack + H2D enqueue + dispatch, fetch = synchronous D2H waits,
-        # decode = stream decode + event expansion.  bench_engine reads
-        # deltas to attribute engine ms/tick between host logic, wire, and
-        # decode -- two perf_counter pairs per flush, noise-level cost.
-        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
+        # decode = stream decode + mirror upkeep, emit = event fan-out +
+        # publish (triples path; the classic host path lumps expansion
+        # into decode_s as before).  bench_engine reads deltas to
+        # attribute engine ms/tick between host logic, wire, and decode.
+        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0,
+                     "emit_s": 0.0}
 
     @property
     def _steady(self) -> bool:
-        """No cap recompile pending (see _CapDecay; benchmarks read this)."""
+        """No cap recompile pending (see _CapDecay/_TriCapDecay; benchmarks
+        read this)."""
+        if self._emit != "host":
+            return self._tri.steady
         return self._caps.steady
 
     def _grow_to(self, n_slots: int) -> None:
@@ -1125,9 +1326,17 @@ class _TPUBucket(_Bucket):
         self._cur_slots = slots  # recovery needs them once _staged is gone
 
         slot_idx = jnp.asarray(slots, jnp.int32)
-        n_chunks_total = s_n * c * self.W // _LANES
-        mc = min(self._max_chunks, max(n_chunks_total, 512))
-        key = (s_n, mc, self._kcap)
+        tri_mode = self._emit != "host"
+        if tri_mode:
+            # triples path (docs/perf.md emit paths): the decode happens ON
+            # DEVICE; harvest fetches [count, 3] triples + one scalar.  The
+            # scratch key uses mc=-1 as the tri namespace (classic mc >= 512)
+            mt = self._max_triples
+            key = (s_n, -1, mt)
+        else:
+            n_chunks_total = s_n * c * self.W // _LANES
+            mc = min(self._max_chunks, max(n_chunks_total, 512))
+            key = (s_n, mc, self._kcap)
         scratch = self._scratch.pop(key, None)
         if scratch is None:
             # keep a few shape variants so alternating staged-slot counts
@@ -1136,14 +1345,21 @@ class _TPUBucket(_Bucket):
             # inflight record double-buffer naturally.
             while len(self._scratch) >= 4:
                 self._scratch.pop(next(iter(self._scratch)))
-            scratch = (
-                jnp.zeros((s_n, c, self.W), jnp.uint32),
-                jnp.zeros((s_n, c, self.W), jnp.uint32),
-                jnp.zeros((mc, self._kcap), jnp.uint32),
-                jnp.zeros((mc, self._kcap), jnp.uint32),
-                jnp.full((mc, self._kcap), -1, jnp.int32),
-                jnp.zeros(mc, jnp.int32),
-            )
+            if tri_mode:
+                scratch = (
+                    jnp.zeros((s_n, c, self.W), jnp.uint32),
+                    jnp.zeros((s_n, c, self.W), jnp.uint32),
+                    jnp.full((mt, 3), -1, jnp.int32),
+                )
+            else:
+                scratch = (
+                    jnp.zeros((s_n, c, self.W), jnp.uint32),
+                    jnp.zeros((s_n, c, self.W), jnp.uint32),
+                    jnp.zeros((mc, self._kcap), jnp.uint32),
+                    jnp.zeros((mc, self._kcap), jnp.uint32),
+                    jnp.full((mc, self._kcap), -1, jnp.int32),
+                    jnp.zeros(mc, jnp.int32),
+                )
         sub = self._hsub[sl]
         if self._mirror is not None and not sub.all():
             self._mirror_stale.update(s for s in slots if s in self._unsub)
@@ -1152,6 +1368,42 @@ class _TPUBucket(_Bucket):
         _tk = _T.t()
         self._fault_phase = "kernel"
         faults.check("aoi.kernel")
+        all_unsub = not sub.any()
+        if tri_mode:
+            out = _fused_bucket_step_tri(
+                self.prev, *scratch, slot_idx, self._dev["x"],
+                self._dev["z"], self._dev["r"], self._dev["act"],
+                self._dev["sub"], mt,
+                "cpu" if self._calc_level >= 1 else None
+            )
+            (self.prev, new, chg, tri, scalars) = out
+            _T.lap("aoi.kernel", _tk)
+            if not all_unsub:
+                scalars.copy_to_host_async()
+            rec = {
+                "mode": "tri",
+                "slots": slots, "s_n": s_n, "key": key, "mt": mt,
+                "epochs": [self._slot_epoch.get(s, 0) for s in slots],
+                "scratch": (new, chg, tri),
+                "scalars": scalars,
+                "all_unsub": all_unsub,
+                "prefetch": None,
+            }
+            if self.pipeline and not all_unsub:
+                # optimistic triple prefetch: D2H rides the wire while the
+                # host runs the next tick; harvest refetches on a misfit
+                ndp = min(mt, self._pred_tri)
+                sl_tri = tri[:ndp]
+                sl_tri.copy_to_host_async()
+                rec["prefetch"] = (ndp, sl_tri)
+            prev_rec, self._inflight = self._inflight, rec
+            self.perf["stage_s"] += time.perf_counter() - t_stage0
+            if self.pipeline:
+                if prev_rec is not None:
+                    self._sched = ("rec", prev_rec)
+            else:
+                self._sched = ("inflight",)
+            return
         out = _fused_bucket_step(
             self.prev, *scratch, slot_idx, self._dev["x"], self._dev["z"],
             self._dev["r"], self._dev["act"], self._dev["sub"],
@@ -1162,7 +1414,6 @@ class _TPUBucket(_Bucket):
          rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg, exc_new,
          scalars) = out
         _T.lap("aoi.kernel", _tk)
-        all_unsub = not sub.any()
         if not all_unsub:
             scalars.copy_to_host_async()
         rec = {
@@ -1473,6 +1724,9 @@ class _TPUBucket(_Bucket):
                           gidx, s_n)
             self._apply_deferred_mirror_ops()
             return
+        if rec.get("mode") == "tri":
+            self._harvest_tri(rec)
+            return
         slots, s_n, mc = rec["slots"], rec["s_n"], rec["mc"]
         kcap = rec["kcap"]
         c = self.capacity
@@ -1534,6 +1788,7 @@ class _TPUBucket(_Bucket):
         elif nd > mc or mcc > kcap:
             # caps exceeded: recover this tick from the full diff, then grow
             # the caps so the next tick extracts on device again
+            self.stats["decode_overflow"] += 1
             self._max_chunks = max(self._max_chunks, 2 * nd)
             # a chunk holds at most _LANES nonzero words
             self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
@@ -1548,6 +1803,7 @@ class _TPUBucket(_Bucket):
         elif n_esc > self._max_gaps or exc_n > self._max_exc:
             # encode overflow (pathological churn): rebuild from the raw
             # grids kept on device
+            self.stats["decode_overflow"] += 1
             ndp = min(mc, -(-max(nd, 1) // 512) * 512)
             slices = (g_vals[:ndp], g_nv[:ndp], g_lane[:ndp], g_csel[:ndp])
             for a in slices:
@@ -1593,39 +1849,174 @@ class _TPUBucket(_Bucket):
             max(64, -(-(n_esc + 1) * 3 // 2 // 64) * 64),
             max(256, -(-(exc_n + 1) * 5 // 4 // 256) * 256),
         )
-        if self._mirror is not None:
-            if len(gidx):
-                # stream entries are whole words with unique indices, so one
-                # fancy-index XOR applies the tick exactly.  Rows whose slot
-                # was released since this tick's dispatch are skipped -- the
-                # same epoch guard that drops the dead space's events; a
-                # reused slot's mirror was already reset at re-acquire and
-                # must not have the dead stream XORed back in.
-                wps = c * self.W
-                gidx = np.asarray(gidx, np.int64)
-                rows = gidx // wps
-                cur = np.fromiter(
-                    (self._slot_epoch.get(s, 0) for s in slots),
-                    np.int64, len(slots))
-                keep = cur[rows] == np.asarray(rec["epochs"], np.int64)[rows]
-                if self._mirror_stale:
-                    # a re-subscribed slot's stream must not XOR onto its
-                    # stale mirror base; the row refreshes from device on
-                    # the next peek instead
-                    stale = np.fromiter(
-                        (s in self._mirror_stale for s in slots),
-                        bool, len(slots))
-                    keep &= ~stale[rows]
-                g, v = (gidx, chg_vals) if keep.all() else (gidx[keep],
-                                                           chg_vals[keep])
-                srows = np.asarray(slots, np.int64)[g // wps]
-                self._mirror.reshape(self.s_max, wps)[srows, g % wps] ^= v
-            self._apply_deferred_mirror_ops()
+        self._mirror_xor_stream(slots, rec["epochs"], gidx, chg_vals)
         # the harvested scratch set returns to the pool for reuse
         self._scratch.setdefault(rec["key"], rec["scratch"])
         self._publish(slots, rec["epochs"], chg_vals, ent_vals, gidx, s_n)
         self.perf["decode_s"] += time.perf_counter() - t_f0
         _T.lap("aoi.diff", _td)
+
+    def _mirror_xor_stream(self, slots, epochs, gidx, chg_vals) -> None:  # gwlint: allow[host-sync] -- harvest-phase mirror upkeep on already-fetched host arrays
+        """Apply one harvested word stream to the host mirror (then run the
+        deferred maintenance ops that postdate it)."""
+        if self._mirror is None:
+            return
+        if len(gidx):
+            # stream entries are whole words with unique indices, so one
+            # fancy-index XOR applies the tick exactly.  Rows whose slot
+            # was released since this tick's dispatch are skipped -- the
+            # same epoch guard that drops the dead space's events; a
+            # reused slot's mirror was already reset at re-acquire and
+            # must not have the dead stream XORed back in.
+            wps = self.capacity * self.W
+            gidx = np.asarray(gidx, np.int64)
+            rows = gidx // wps
+            cur = np.fromiter(
+                (self._slot_epoch.get(s, 0) for s in slots),
+                np.int64, len(slots))
+            keep = cur[rows] == np.asarray(epochs, np.int64)[rows]
+            if self._mirror_stale:
+                # a re-subscribed slot's stream must not XOR onto its
+                # stale mirror base; the row refreshes from device on
+                # the next peek instead
+                stale = np.fromiter(
+                    (s in self._mirror_stale for s in slots),
+                    bool, len(slots))
+                keep &= ~stale[rows]
+            g, v = (gidx, chg_vals) if keep.all() else (gidx[keep],
+                                                        chg_vals[keep])
+            srows = np.asarray(slots, np.int64)[g // wps]
+            self._mirror.reshape(self.s_max, wps)[srows, g % wps] ^= v
+        self._apply_deferred_mirror_ops()
+
+    def _mirror_xor_triples(self, slots, epochs, tri) -> None:  # gwlint: allow[host-sync] -- harvest-phase mirror upkeep on already-fetched host arrays
+        """Apply a tick's triples to the host mirror.  Each triple flips one
+        unique (row, bit), so a scatter-XOR of single-bit masks applies the
+        tick exactly; the epoch/stale guards mirror _mirror_xor_stream."""
+        c = self.capacity
+        obs = tri[:, 0].astype(np.int64)
+        rows = obs // c
+        cur = np.fromiter(
+            (self._slot_epoch.get(s, 0) for s in slots),
+            np.int64, len(slots))
+        keep = cur[rows] == np.asarray(epochs, np.int64)[rows]
+        if self._mirror_stale:
+            stale = np.fromiter(
+                (s in self._mirror_stale for s in slots),
+                bool, len(slots))
+            keep &= ~stale[rows]
+        if not keep.all():
+            obs, rows, tri = obs[keep], rows[keep], tri[keep]
+        j = tri[:, 1].astype(np.int64)
+        srows = np.asarray(slots, np.int64)[rows]
+        # planar layout: column j lives at word j % W, bit j // W
+        gw = (srows * c + obs % c) * self.W + j % self.W
+        bit = (j // self.W).astype(np.uint32)
+        np.bitwise_xor.at(self._mirror.reshape(-1), gw, np.uint32(1) << bit)
+
+    def _harvest_tri(self, rec) -> None:  # gwlint: allow[host-sync] -- triples-path drain point: fetches the compact triple buffer once per flush
+        """Harvest one tri-mode tick: fetch the compact (observer, observed,
+        kind) triples + count scalar, XOR the mirror, and fan the pairs out
+        through the native/vector emit layer (docs/perf.md emit paths)."""
+        slots, s_n, mt = rec["slots"], rec["s_n"], rec["mt"]
+        c = self.capacity
+        (new, chg, tri) = rec["scratch"]
+        faults.check("aoi.fetch")  # stallable: a delayed host sync
+        t_f0 = time.perf_counter()
+        _tf = _T.t()
+        poisoned = False
+        if rec.get("all_unsub"):
+            count = 0
+        else:
+            raw = faults.filter("aoi.scalars", np.asarray(rec["scalars"]))
+            count = int(raw[0])
+            if not 0 <= count <= s_n * c * c:
+                from ..utils import gwlog
+
+                self.stats["poisoned"] += 1
+                gwlog.logger("gw.aoi").warning(
+                    "AOI triple count failed validation (count=%d); "
+                    "recovering the tick from the raw diff grids", count)
+                poisoned = True
+        shrink = (None if poisoned or count > mt else
+                  self._tri.observe(count, self._max_triples))
+        if shrink is not None:
+            self._max_triples = shrink
+        if poisoned or count > mt:
+            # triple-capacity overflow (or corrupt count): the compact
+            # buffer is truncated, so recover this tick from the raw diff
+            # grids riding the same record, then grow the cap so the next
+            # tick compacts on device again (counted, never silent --
+            # docs/robustness.md)
+            if not poisoned:
+                self.stats["decode_overflow"] += 1
+                if self._max_triples < _TRI_MAX:
+                    self._max_triples = min(
+                        _TRI_MAX, 1 << (2 * count - 1).bit_length())
+                self._tri.reset_after_growth()
+            chg_h = np.asarray(chg).reshape(-1)
+            new_h = np.asarray(new).reshape(-1)
+            gidx = np.nonzero(chg_h)[0]
+            chg_vals = chg_h[gidx]
+            ent_vals = chg_vals & new_h[gidx]
+            self.perf["fetch_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.fetch", _tf)
+            t_f0 = time.perf_counter()
+            _td = _T.t()
+            self._mirror_xor_stream(slots, rec["epochs"], gidx, chg_vals)
+            self._scratch.setdefault(rec["key"], rec["scratch"])
+            self._publish(slots, rec["epochs"], chg_vals, ent_vals, gidx,
+                          s_n)
+            self.perf["decode_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.diff", _td)
+            return
+        if count == 0:
+            tri_h = np.empty((0, 3), np.int32)
+        else:
+            pf = rec["prefetch"]
+            if pf is not None and pf[0] >= count:
+                tri_h = np.asarray(pf[1])[:count]
+            else:
+                ndp = min(mt, -(-count // 256) * 256)
+                sl_tri = tri[:ndp]
+                sl_tri.copy_to_host_async()
+                tri_h = np.asarray(sl_tri)[:count]
+        self.perf["fetch_s"] += time.perf_counter() - t_f0
+        _T.lap("aoi.fetch", _tf)
+        # refit the next dispatch's optimistic prefetch to this tick
+        self._pred_tri = max(
+            2048, min(self._max_triples, -(-count * 5 // 4 // 256) * 256))
+        t_f0 = time.perf_counter()
+        _td = _T.t()
+        if self._mirror is not None:
+            if len(tri_h):
+                self._mirror_xor_triples(slots, rec["epochs"], tri_h)
+            self._apply_deferred_mirror_ops()
+        self._scratch.setdefault(rec["key"], rec["scratch"])
+        self.perf["decode_s"] += time.perf_counter() - t_f0
+        _T.lap("aoi.decode", _td)
+        t_f0 = time.perf_counter()
+        _te = _T.t()
+        try:
+            faults.check("aoi.emit")
+            pe, pl = AE.fanout_triples(tri_h, c,
+                                       native=(self._emit == "native"))
+        except Exception as e:
+            if not (_device_fault(e) or isinstance(e, RuntimeError)):
+                raise
+            # emit seam tripped (or the native layer rejected the buffer):
+            # demote sticky to host decode and publish this tick through
+            # the oracle path -- bit-exact, mirror untouched (_publish
+            # never XORs)
+            _demote_emit(self, e)
+            chg_vals, ent_vals, gidx = EV.triples_to_words(tri_h, c)
+            self._publish(slots, rec["epochs"], chg_vals, ent_vals, gidx,
+                          s_n)
+        else:
+            self._publish_pairs(slots, rec["epochs"], _split_rows(pe),
+                                _split_rows(pl))
+        self.perf["emit_s"] += time.perf_counter() - t_f0
+        _T.lap("aoi.emit", _te)
 
     def _apply_deferred_mirror_ops(self) -> None:
         """Clears issued after a tick's dispatch apply now, AFTER its
@@ -1645,11 +2036,15 @@ class _TPUBucket(_Bucket):
                  s_n: int) -> None:
         """Expand a classified change stream into per-slot (enter, leave)
         pair arrays and merge them into the deliverable events (shared by
-        the device harvest and the host-recovery tick)."""
-        pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx,
-                                           self.capacity, s_n)
-        ent_rows = _split_rows(pe)
-        lv_rows = _split_rows(pl)
+        the device harvest and the host-recovery tick).  The expansion runs
+        through the bucket's emit path (native C++ when emit="native", host
+        numpy otherwise) -- identical order either way."""
+        pe, pl = _emit_expand(self, chg_vals, ent_vals, gidx, s_n)
+        self._publish_pairs(slots, epochs, _split_rows(pe), _split_rows(pl))
+
+    def _publish_pairs(self, slots, epochs, ent_rows, lv_rows) -> None:
+        """Merge per-space-row (enter, leave) pair dicts into the
+        deliverable events, under the slot-epoch liveness guard."""
         empty = np.empty((0, 2), np.int32)
         for row, (slot, epoch) in enumerate(zip(slots, epochs)):
             if self._slot_epoch.get(slot, 0) != epoch:

@@ -62,10 +62,11 @@ import numpy as np
 
 from .. import faults
 from ..telemetry import trace as _T
+from ..ops import aoi_emit as AE
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import (_Bucket, _CapDecay, _device_fault, _kernelish_fault,
-                  _packed_predicate, _split_rows)
+from .aoi import (_Bucket, _CapDecay, _device_fault, _emit_expand,
+                  _kernelish_fault, _packed_predicate, _split_rows)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -76,9 +77,17 @@ class _MeshTPUBucket(_Bucket):
     the mesh's 'space' axis; one fused shard_map dispatch per flush."""
 
     def __init__(self, capacity: int, mesh, pipeline: bool = False,
-                 delta_staging: bool = True):
+                 delta_staging: bool = True, emit: str = "vector"):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
+
+        # emit path for the harvested word streams (docs/perf.md emit
+        # paths): "native" hands bit expansion + sort to libgwemit; on the
+        # multi-chip tiers "vector" and "host" are both the numpy
+        # expand_classified_host (the split only diverges single-chip).
+        # _emit_requested re-arms after a seam demotion (reset_emit_path).
+        self._emit = emit
+        self._emit_requested = emit
 
         self.mesh = mesh  # parallel.SpaceMesh
         self.n_dev = mesh.n_devices
@@ -139,7 +148,8 @@ class _MeshTPUBucket(_Bucket):
         self._cur_slots: list[int] = []
         self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
-                      "poisoned": 0, "calc_level": 0}
+                      "poisoned": 0, "calc_level": 0, "decode_overflow": 0,
+                      "emit_path": AE.EMIT_LEVEL[emit]}
         # pipelined tick awaiting harvest
         self._inflight = None
         # split-phase flush (docs/perf.md): dispatch() parks what harvest()
@@ -162,7 +172,8 @@ class _MeshTPUBucket(_Bucket):
         self.full_roundtrips = 0
         # optimistic per-chip prefetch sizes (rows, escapes, exceptions)
         self._pred = (256, 64, 256)
-        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
+        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0,
+                     "emit_s": 0.0}
 
     @property
     def _steady(self) -> bool:
@@ -1013,8 +1024,7 @@ class _MeshTPUBucket(_Bucket):
                  s_n: int) -> None:
         """Expand a compact-layout classified stream into per-slot events
         (host-recovery ticks; the device harvest keys by global slot)."""
-        pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx,
-                                           self.capacity, s_n)
+        pe, pl = _emit_expand(self, chg_vals, ent_vals, gidx, s_n)
         ent_rows = _split_rows(pe)
         lv_rows = _split_rows(pl)
         empty = np.empty((0, 2), np.int32)
@@ -1120,6 +1130,7 @@ class _MeshTPUBucket(_Bucket):
                 # (see the scalar peek there), so the read is safe.
                 self._max_chunks = max(self._max_chunks, 2 * nd)
                 self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
+                self.stats["decode_overflow"] += 1
                 grew = True
                 lo = d * s_local
                 chg_h = np.asarray(chg[lo:lo + s_local]).reshape(-1)
@@ -1133,6 +1144,7 @@ class _MeshTPUBucket(_Bucket):
                 # encode overflow: rebuild from the kept chunk grids
                 self._max_gaps = max(mg, 2 * n_esc)
                 self._max_exc = max(mx, 2 * exc_n)
+                self.stats["decode_overflow"] += 1
                 grew = True
                 lo = d * mc
                 vh = np.asarray(g_vals[lo:lo + mc])
@@ -1220,11 +1232,17 @@ class _MeshTPUBucket(_Bucket):
         # clears issued after this tick's dispatch apply now, AFTER its
         # stream (see _apply_deferred_mirror_ops)
         self._apply_deferred_mirror_ops()
+        self.perf["decode_s"] += time.perf_counter() - t0
+        _T.lap("aoi.diff", _td)
+        t0 = time.perf_counter()
+        _te = _T.t()
         empty = np.empty((0, 2), np.int32)
         if all_c:
-            pe, pl = EV.expand_classified_host(
-                np.concatenate(all_c), np.concatenate(all_e),
-                np.concatenate(all_g), c, self.s_max)
+            # fan-out through the bucket's emit path (C++ bit expansion
+            # when emit="native"; bit-exact either way)
+            pe, pl = _emit_expand(
+                self, np.concatenate(all_c), np.concatenate(all_e),
+                np.concatenate(all_g), self.s_max)
         else:
             pe = pl = np.empty((0, 3), np.int32)
         ent_rows = _split_rows(pe)
@@ -1247,5 +1265,5 @@ class _MeshTPUBucket(_Bucket):
         # would pin a full [S,C,W] chg buffer in device memory indefinitely
         if rec["key"] == (self.s_max, self._max_chunks, self._kcap):
             self._scratch.setdefault(rec["key"], rec["scratch"])
-        self.perf["decode_s"] += time.perf_counter() - t0
-        _T.lap("aoi.diff", _td)
+        self.perf["emit_s"] += time.perf_counter() - t0
+        _T.lap("aoi.emit", _te)

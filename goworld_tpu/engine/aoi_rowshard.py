@@ -50,8 +50,9 @@ from .. import faults
 from ..telemetry import trace as _T
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import (_Bucket, _CapDecay, _device_fault, _kernelish_fault,
-                  _packed_predicate)
+from ..ops import aoi_emit as AE
+from .aoi import (_Bucket, _CapDecay, _device_fault, _emit_expand,
+                  _kernelish_fault, _packed_predicate)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -63,9 +64,14 @@ class _RowShardTPUBucket(_Bucket):
     exclusive = True  # engine: one bucket per space, dropped at release
 
     def __init__(self, capacity: int, mesh, pipeline: bool = False,
-                 delta_staging: bool = True):
+                 delta_staging: bool = True, emit: str = "vector"):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
+
+        # emit path for the harvested word streams (docs/perf.md emit
+        # paths; see _MeshTPUBucket -- "vector" and "host" coincide here)
+        self._emit = emit
+        self._emit_requested = emit
 
         self.mesh = mesh
         self.n_dev = mesh.n_devices
@@ -122,10 +128,12 @@ class _RowShardTPUBucket(_Bucket):
         self._sched: tuple | None = None
         self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
-                      "poisoned": 0, "calc_level": 0}
+                      "poisoned": 0, "calc_level": 0, "decode_overflow": 0,
+                      "emit_path": AE.EMIT_LEVEL[emit]}
         self._pred = (512, 64, 256)
         self.full_roundtrips = 0
-        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0}
+        self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0,
+                     "emit_s": 0.0}
 
     @property
     def _steady(self) -> bool:
@@ -624,6 +632,7 @@ class _RowShardTPUBucket(_Bucket):
                 # incomplete stream: recover from this chip's raw diff grid
                 self._max_chunks = max(self._max_chunks, 2 * nd)
                 self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
+                self.stats["decode_overflow"] += 1
                 grew = True
                 lo = d * cl
                 chg_h = np.asarray(chg[lo:lo + cl]).reshape(-1)
@@ -636,6 +645,7 @@ class _RowShardTPUBucket(_Bucket):
             elif n_esc > mg or exc_n > mx:
                 self._max_gaps = max(mg, 2 * n_esc)
                 self._max_exc = max(mx, 2 * exc_n)
+                self.stats["decode_overflow"] += 1
                 grew = True
                 lo = d * mc
                 vh = np.asarray(g_vals[lo:lo + mc])
@@ -694,12 +704,14 @@ class _RowShardTPUBucket(_Bucket):
             max(256, -(-(peak[2] + 1) * 5 // 4 // 256) * 256),
         )
         t0 = time.perf_counter()
-        _td = _T.t()
+        _te = _T.t()
         empty = np.empty((0, 2), np.int32)
         if all_c:
-            pe, pl = EV.expand_classified_host(
-                np.concatenate(all_c), np.concatenate(all_e),
-                np.concatenate(all_g), c, 1)
+            # fan-out through the bucket's emit path (C++ bit expansion
+            # when emit="native"; bit-exact either way)
+            pe, pl = _emit_expand(
+                self, np.concatenate(all_c), np.concatenate(all_e),
+                np.concatenate(all_g), 1)
             e = pe[:, 1:] if len(pe) else empty
             l = pl[:, 1:] if len(pl) else empty
         else:
@@ -711,8 +723,8 @@ class _RowShardTPUBucket(_Bucket):
         self._events[0] = (e, l)
         if rec["key"] == (self._max_chunks, self._kcap):
             self._scratch.setdefault(rec["key"], rec["scratch"])
-        self.perf["decode_s"] += time.perf_counter() - t0
-        _T.lap("aoi.diff", _td)
+        self.perf["emit_s"] += time.perf_counter() - t0
+        _T.lap("aoi.emit", _te)
 
     # -- fault recovery (docs/robustness.md): no standing mirror at this
     # size, so the durable old state is reconstructed on demand -- the
@@ -814,8 +826,7 @@ class _RowShardTPUBucket(_Bucket):
             gidx = np.nonzero(flat)[0]
             chg_vals = flat[gidx]
             ent_vals = chg_vals & new.reshape(-1)[gidx]
-            pe, pl = EV.expand_classified_host(chg_vals, ent_vals, gidx,
-                                               self.capacity, 1)
+            pe, pl = _emit_expand(self, chg_vals, ent_vals, gidx, 1)
             e = pe[:, 1:] if len(pe) else empty
             l = pl[:, 1:] if len(pl) else empty
         else:

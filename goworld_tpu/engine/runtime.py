@@ -49,6 +49,7 @@ class Runtime:
         aoi_tpu_min_capacity: int = 4096,
         aoi_rowshard_min_capacity: int = 65536,
         aoi_flush_sched: bool = True,
+        aoi_emit: str = "auto",
         fault_plan: "faults.FaultPlan | str | None" = None,
         telemetry_on: bool = False,
     ):
@@ -73,7 +74,7 @@ class Runtime:
                              delta_staging=aoi_delta_staging,
                              tpu_min_capacity=aoi_tpu_min_capacity,
                              rowshard_min_capacity=aoi_rowshard_min_capacity,
-                             flush_sched=aoi_flush_sched)
+                             flush_sched=aoi_flush_sched, emit=aoi_emit)
         self.entities = EntityManager(self)
         self.tick_count = 0
         # entities with pending sync flags / attr deltas / quiet countdowns;

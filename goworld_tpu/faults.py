@@ -36,6 +36,11 @@ seam                      fires in
 ``aoi.fetch``             event-stream harvest drain (stall: delay the
                           host sync; fail/oom: the fault a dispatched
                           kernel surfaces at its blocking fetch)
+``aoi.emit``              native event fan-out (libgwemit) during harvest
+                          publish -- handled LOCALLY: the bucket demotes
+                          to the host decode path and republishes the
+                          same tick bit-exactly (never reaches the
+                          device-fault recovery)
 ``conn.send``             typed packet send (proto/connection.py)
 ``conn.flush``            framed batch write (netutil/conn.py flush)
 ``conn.recv``             blocking packet read (netutil/conn.py recv)
@@ -80,6 +85,8 @@ SEAMS = {
     "aoi.scalars": "control-scalar fetch (poisonable; validated at harvest)",
     "aoi.fetch": "harvest-phase host sync (stallable; fail/oom = async "
                  "dispatch errors surfacing at the blocking fetch)",
+    "aoi.emit": "native event fan-out during harvest publish (demotes to "
+                "host decode, same-tick bit-exact fallback)",
     "conn.send": "typed packet send",
     "conn.flush": "framed batch write",
     "conn.recv": "blocking packet read",

@@ -11,8 +11,16 @@ compacted on device into fixed-capacity index lists (static shapes under jit).
     sorted lexicographically by (observer, observed) -- the deterministic
     callback replay order;
   * count: the true number of set bits (may exceed max_events; the caller
-    detects overflow with count > max_events and falls back to host-side
-    unpacking of the mask for that rare tick).
+    detects overflow with count > max_events and falls back to
+    :func:`pairs_overflow_host` on the ALREADY-fetched host words for that
+    rare tick -- counted per bucket as ``decode_overflow``, never repaying
+    the full-mask unpack).
+
+``extract_triples(chg, new, capacity, max_triples)`` is the device-resident
+decode the production buckets run (docs/perf.md emit paths): it compacts a
+classified diff into fixed-capacity (observer, observed, kind) int32
+triples ON DEVICE, so harvest fetches the compact triple buffer plus one
+count scalar instead of word grids that still need host bit expansion.
 """
 
 from __future__ import annotations
@@ -365,3 +373,114 @@ def expand_classified_host(chg_vals, ent_vals, flat_idx, capacity: int,  # gwlin
     is_ent = ((ent_vals[widx] >> k.astype(np.uint32)) & 1).astype(bool)
     return (_sorted_pairs(s[is_ent], i[is_ent], j[is_ent], capacity),
             _sorted_pairs(s[~is_ent], i[~is_ent], j[~is_ent], capacity))
+
+
+def extract_triples(chg, new, capacity: int, max_triples: int):
+    """Classified diff words -> compact (observer, observed, kind) triples,
+    entirely on device (docs/perf.md emit paths).
+
+    Two-pass compaction sized by an exact popcount (NOT a silent cap):
+    pass 1 compacts the nonzero WORDS of the flat change grid (there are at
+    most ``count`` of them, so the same ``max_triples`` budget covers both
+    passes on every non-overflow tick); pass 2 expands the surviving words
+    into a [max_triples, 32] bit matrix and compacts the set BITS.  When
+    ``count > max_triples`` the triple buffer is incomplete and the caller
+    must fall back (a counted, per-tick event -- bucket ``decode_overflow``
+    stat), which is why the dropped pass-1 words never matter.
+
+    ``chg``/``new`` are uint32 planar words of any leading shape whose flat
+    word order defines the observer index: ``obs = flat_word // W`` (for
+    the bucket grids [s_n, C, W] that is the global observer row
+    ``s * C + i``).  ``kind`` is 1 for enter (the bit's NEW interest state),
+    0 for leave.
+
+    Returns ``(tri [max_triples, 3] int32, count i32)``.  ``tri`` rows are
+    (-1, -1, -1)-filled past the real triples and UNSORTED (pass order is
+    (word, bit), not (observer, observed)); the emit layer
+    (:mod:`goworld_tpu.ops.aoi_emit`) owns the deterministic callback-order
+    sort.
+    """
+    w = words_per_row(capacity)
+    flat_c = chg.reshape(-1)
+    flat_n = new.reshape(-1)
+    count = popcount_total(chg)
+    (widx,) = jnp.nonzero(flat_c != jnp.uint32(0), size=max_triples,
+                          fill_value=-1)
+    wsel = jnp.maximum(widx, 0)
+    wvals = jnp.where(widx >= 0, flat_c[wsel], jnp.uint32(0))
+    nvals = jnp.where(widx >= 0, flat_n[wsel], jnp.uint32(0))
+    shifts = jnp.arange(WORD_BITS, dtype=jnp.uint32)[None, :]
+    bits = (wvals[:, None] >> shifts) & jnp.uint32(1)
+    (sel,) = jnp.nonzero(bits.reshape(-1) != 0, size=max_triples,
+                         fill_value=-1)
+    sp = jnp.maximum(sel, 0)
+    slot = sp // WORD_BITS
+    k = (sp % WORD_BITS).astype(jnp.uint32)
+    g = widx[slot]
+    obs = g // w
+    j = k.astype(jnp.int32) * w + g % w
+    kind = ((nvals[slot] >> k) & jnp.uint32(1)).astype(jnp.int32)
+    valid = sel >= 0
+    tri = jnp.stack([jnp.where(valid, obs, -1),
+                     jnp.where(valid, j, -1),
+                     jnp.where(valid, kind, -1)], axis=1).astype(jnp.int32)
+    return tri, count
+
+
+def triples_to_words(tri, capacity: int):  # gwlint: allow[host-sync] -- pure numpy on already-fetched triples
+    """Reconstruct the classified word stream from already-fetched triples.
+
+    The bridge back to the classic host decode: the triples-mode mirror
+    XOR and the ``aoi.emit`` fault fallback both need (chg_vals, ent_vals,
+    gidx) exactly as :func:`decode_row_stream` would have produced them.
+    Inverse of :func:`extract_triples` up to word grouping; bit-exact by
+    construction (each triple is one unique (word, bit)).
+
+    ``tri`` must hold only VALID rows ([n, 3] int32).  Returns
+    ``(chg_vals u32 [K], ent_vals u32 [K], gidx i64 [K])`` with ``gidx``
+    ascending.
+    """
+    import numpy as np
+
+    w = words_per_row(capacity)
+    if len(tri) == 0:
+        z = np.empty(0, np.uint32)
+        return z, z, np.empty(0, np.int64)
+    obs = tri[:, 0].astype(np.int64)
+    j = tri[:, 1].astype(np.int64)
+    ent = tri[:, 2] == 1
+    g = obs * w + j % w
+    bit = (j // w).astype(np.uint32)
+    gidx = np.unique(g)
+    grp = np.searchsorted(gidx, g)
+    chg_vals = np.zeros(len(gidx), np.uint32)
+    ent_vals = np.zeros(len(gidx), np.uint32)
+    np.bitwise_or.at(chg_vals, grp, np.uint32(1) << bit)
+    np.bitwise_or.at(ent_vals, grp[ent], np.uint32(1) << bit[ent])
+    return chg_vals, ent_vals, gidx
+
+
+def pairs_overflow_host(words, capacity: int):  # gwlint: allow[host-sync] -- overflow fallback consumes the already-fetched words
+    """:func:`extract_pairs` overflow fallback on the ALREADY-fetched words.
+
+    When ``count > max_events`` the device pair list is incomplete; the old
+    fallback re-unpacked the full [capacity, capacity] mask on host (O(C^2)
+    bools for what is usually a handful of extra events).  This expands
+    only the NONZERO words of the host copy instead -- O(count) work -- so
+    an overflow tick reuses the words it already paid to fetch.
+
+    Returns (observer, observed) int32 [K, 2], sorted lexicographically --
+    identical to the non-overflow ``extract_pairs`` ordering.
+    """
+    import numpy as np
+
+    w = words_per_row(capacity)
+    flat = np.ascontiguousarray(words, np.uint32).reshape(-1)
+    gidx = np.nonzero(flat)[0]
+    if len(gidx) == 0:
+        return np.empty((0, 2), np.int32)
+    # one implicit "space" of `capacity` rows: _expand_bits yields s == 0
+    _, i, j, _, _ = _expand_bits(flat[gidx], gidx, capacity, w)
+    out = np.stack([i, j], axis=1).astype(np.int32)
+    key = i.astype(np.int64) * capacity + j
+    return out[np.argsort(key)]

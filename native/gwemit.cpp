@@ -1,0 +1,123 @@
+// gwemit: native event emit fan-out.
+//
+// The host half of the device-resident event decode (docs/perf.md emit
+// paths): the device compacts a tick's classified AOI diff into raw
+// (observer, observed, kind) int32 triples (goworld_tpu/ops/events.py
+// extract_triples); this library turns them into the ready-to-replay
+// enter/leave pair lists -- slot->row split, enter/leave partitioning, and
+// the deterministic (space, observer, observed) callback-order sort -- off
+// the per-pair Python path.
+//
+// Ordering contract (must stay bit-exact with ops/events.py
+// expand_classified_host / _sorted_pairs): rows ascend by the single
+// integer key ((s * cap + i) * cap + j) == (obs * cap + j) with
+// obs = s * cap + i.  Keys are unique within a tick (one bit per pair), so
+// any comparison sort reproduces the numpy argsort order exactly.
+//
+// C ABI (ctypes, loaded by goworld_tpu/ops/aoi_emit.py):
+//   int64_t gwemit_fanout(const int32_t* tri, int64_t n, int32_t cap,
+//                         int32_t* enter, int32_t* leave,
+//                         int64_t* n_leave_out);
+//       tri: [n, 3] raw triples (obs = global observer row, j = observed
+//       column, kind 1 = enter).  enter/leave: caller-allocated [n, 3]
+//       (space, observer, observed) rows -- enter_n + leave_n == n so n
+//       rows each always suffice.  Returns n_enter, or -1 on bad input.
+//   int64_t gwemit_count(const uint32_t* vals, int64_t n);
+//       Total set bits of a word stream (exact output sizing for
+//       gwemit_words).
+//   int64_t gwemit_words(const uint32_t* chg, const uint32_t* ent,
+//                        const int64_t* gidx, int64_t n, int32_t cap,
+//                        int32_t w,
+//                        int32_t* enter, int64_t enter_cap,
+//                        int32_t* leave, int64_t leave_cap,
+//                        int64_t* n_leave_out);
+//       Classified word-stream expansion (the mesh/rowshard emit path):
+//       gidx are flat word indices over [s, cap, w] grids; bit k of chg[t]
+//       is pair (observer gidx[t]/w, column k*w + gidx[t]%w), an enter when
+//       the same bit of ent[t] is set.  Returns n_enter, or -1 on bad
+//       input / undersized buffers.
+//
+// Build: make -C native (produces libgwemit.so).
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+// Decompose the sort key back into sorted (space, observer, observed) rows.
+void write_rows(std::vector<uint64_t>& keys, int64_t cap, int32_t* out) {
+    std::sort(keys.begin(), keys.end());
+    for (size_t t = 0; t < keys.size(); ++t) {
+        const uint64_t key = keys[t];
+        const int64_t j = static_cast<int64_t>(key % (uint64_t)cap);
+        const int64_t obs = static_cast<int64_t>(key / (uint64_t)cap);
+        out[3 * t] = static_cast<int32_t>(obs / cap);
+        out[3 * t + 1] = static_cast<int32_t>(obs % cap);
+        out[3 * t + 2] = static_cast<int32_t>(j);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t gwemit_fanout(const int32_t* tri, int64_t n, int32_t cap,
+                      int32_t* enter, int32_t* leave, int64_t* n_leave_out) {
+    if (n < 0 || cap <= 0) return -1;
+    std::vector<uint64_t> ek, lk;
+    ek.reserve(static_cast<size_t>(n));
+    lk.reserve(static_cast<size_t>(n));
+    for (int64_t t = 0; t < n; ++t) {
+        const int32_t obs = tri[3 * t];
+        const int32_t j = tri[3 * t + 1];
+        const int32_t kind = tri[3 * t + 2];
+        if (obs < 0 || j < 0 || j >= cap) return -1;
+        const uint64_t key =
+            (uint64_t)obs * (uint64_t)cap + (uint64_t)j;
+        if (kind == 1) ek.push_back(key); else lk.push_back(key);
+    }
+    write_rows(ek, cap, enter);
+    write_rows(lk, cap, leave);
+    *n_leave_out = static_cast<int64_t>(lk.size());
+    return static_cast<int64_t>(ek.size());
+}
+
+int64_t gwemit_count(const uint32_t* vals, int64_t n) {
+    int64_t total = 0;
+    for (int64_t t = 0; t < n; ++t) total += __builtin_popcount(vals[t]);
+    return total;
+}
+
+int64_t gwemit_words(const uint32_t* chg, const uint32_t* ent,
+                     const int64_t* gidx, int64_t n, int32_t cap, int32_t w,
+                     int32_t* enter, int64_t enter_cap,
+                     int32_t* leave, int64_t leave_cap,
+                     int64_t* n_leave_out) {
+    if (n < 0 || cap <= 0 || w <= 0) return -1;
+    std::vector<uint64_t> ek, lk;
+    for (int64_t t = 0; t < n; ++t) {
+        const int64_t fi = gidx[t];
+        if (fi < 0) return -1;
+        const int64_t obs = fi / w;           // global observer row s*cap + i
+        const int64_t word = fi % w;
+        uint32_t c = chg[t];
+        const uint32_t e = ent[t];
+        while (c) {
+            const int k = __builtin_ctz(c);
+            c &= c - 1;
+            const int64_t j = (int64_t)k * w + word;
+            if (j >= cap) return -1;
+            const uint64_t key = (uint64_t)obs * (uint64_t)cap + (uint64_t)j;
+            if ((e >> k) & 1u) ek.push_back(key); else lk.push_back(key);
+        }
+    }
+    if ((int64_t)ek.size() > enter_cap || (int64_t)lk.size() > leave_cap)
+        return -1;
+    write_rows(ek, cap, enter);
+    write_rows(lk, cap, leave);
+    *n_leave_out = static_cast<int64_t>(lk.size());
+    return static_cast<int64_t>(ek.size());
+}
+
+}  // extern "C"

@@ -42,7 +42,13 @@ JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py || fail=1
 echo "== flush_sched smoke =="
 JAX_PLATFORMS=cpu python scripts/flush_sched_smoke.py || fail=1
 
-# 7. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 7. emit-path smoke (CPU backend: triples decode + native/vector/host
+#    fan-out parity with one forced-overflow tick, span-sourced phase
+#    report -- docs/perf.md emit paths)
+echo "== emit smoke =="
+JAX_PLATFORMS=cpu python scripts/emit_smoke.py || fail=1
+
+# 8. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

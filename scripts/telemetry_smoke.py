@@ -63,10 +63,14 @@ def main():
         rt.tick()
 
     # 1. the engine phase spans bench.py turns into phase_ms are recorded
+    # (the default triples emit path laps aoi.decode; the classic word-stream
+    # path laps aoi.diff instead -- docs/observability.md)
     names = {nm for nm, _tid, _t0, _t1 in trace.spans()}
     for want in ("tick", "tick.aoi", "aoi.flush", "aoi.stage", "aoi.kernel",
-                 "aoi.fetch", "aoi.diff", "aoi.emit"):
+                 "aoi.fetch", "aoi.emit"):
         assert want in names, f"span {want!r} missing from {sorted(names)}"
+    assert "aoi.decode" in names or "aoi.diff" in names, \
+        f"neither decode span present in {sorted(names)}"
 
     # 2. scrape the endpoints like Prometheus / Perfetto would
     srv = binutil.setup_http_server(0)

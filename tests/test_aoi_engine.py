@@ -142,6 +142,9 @@ def test_tpu_encode_overflow_slow_path_parity():
     every tick; events must stay bit-identical to the CPU oracle (the slow
     path is the correctness net for pathological churn)."""
     def shrink(bucket):
+        # pin the classic stream path: the encode caps don't exist on the
+        # triples path (its overflow is test_aoi_emit.py's job)
+        bucket._emit = bucket._emit_requested = "host"
         bucket._max_exc = 4       # any multi-bit/tail word overflows
         bucket._max_gaps = 4
 
@@ -157,8 +160,10 @@ def test_tpu_cap_overflow_full_diff_recovery_parity():
     tweaked = []
 
     def shrink(bucket):
-        # the flush floors mc at 512 chunks, far above this scene's 16 --
-        # the words-per-chunk cap is what forces the overflow here
+        # pin the classic stream path (the triples path has no kcap); the
+        # flush floors mc at 512 chunks, far above this scene's 16 -- the
+        # words-per-chunk cap is what forces the overflow here
+        bucket._emit = bucket._emit_requested = "host"
         bucket._kcap = 4
         tweaked.append(bucket)
 

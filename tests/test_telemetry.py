@@ -53,8 +53,8 @@ CANONICAL_NAMES = (
     "tick.post",
     # AOI engine phase spans + engine gauges
     "aoi.flush", "aoi.emit", "aoi.h2d", "aoi.stage", "aoi.kernel",
-    "aoi.fetch", "aoi.diff", "aoi.host_tick", "aoi.buckets",
-    "aoi.calc_level",
+    "aoi.fetch", "aoi.diff", "aoi.decode", "aoi.host_tick", "aoi.buckets",
+    "aoi.calc_level", "aoi.emit_path",
     # opmon op names (components + net + storage)
     "conn.flush", "gate.client_pkt", "game.outbox", "disp.route",
     "storage.op",
@@ -115,8 +115,10 @@ def test_single_chip_parity_on_vs_off():
     off = _walk()
     on, names = _traced_walk()
     _assert_on_off_identical(off, on)
-    assert {"aoi.stage", "aoi.kernel", "aoi.fetch", "aoi.diff"} <= names, \
-        names
+    # the single-chip default is the triples path: its harvest laps are
+    # aoi.decode (mirror upkeep) + aoi.emit (fan-out), not aoi.diff
+    assert {"aoi.stage", "aoi.kernel", "aoi.fetch", "aoi.decode",
+            "aoi.emit"} <= names, names
 
 
 def _mesh_devices():
