@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import zlib
 
 import numpy as np
 
@@ -1438,6 +1439,13 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     if span_s.get("tick"):
         out["span_tick_ms"] = round(
             span_s["tick"] / total_ticks * 1e3, 2)
+    # engine-level twin of run_config's wall_vs_device_ratio: wall tick
+    # time over the calculator span (aoi.kernel = the device kernel on a
+    # chip, the native/oracle sweep on a host bucket), so a CPU-container
+    # artifact still records the ratio the emit/decode work is held to
+    if out["phase_ms"].get("kernel"):
+        out["wall_vs_device_ratio"] = round(
+            out["tick_ms"] / max(out["phase_ms"]["kernel"], 1e-3), 2)
     # split-phase scheduler A/B bookkeeping (docs/perf.md): the checksum
     # folds every delivered enter/leave pair in delivery order, so a
     # scheduler-on and scheduler-off run of the same config must print the
@@ -1471,6 +1479,143 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
             out["aoi_decode_overflow"] = (stats1["decode_overflow"]
                                           - stats0.get("decode_overflow", 0))
     return out
+
+
+def _resilience_walk(cap, world, ticks, tier, plan=None, migrate_to=None,
+                     migrate_at=-1, seed=17):
+    """One deterministic walk straight through AOIEngine (the layer the
+    placement controller lives on), optionally with a fault plan installed
+    or a live migration started mid-walk.  Folds a crc32 over every
+    delivered enter/leave delta -- the same parity oracle the migration
+    tests and scripts/migration_smoke.py use -- and times every tick.
+
+    Returns (crc, per-tick wall seconds, total delivered events, the tick
+    the first evacuation landed on (-1 if none), engine, handle)."""
+    from goworld_tpu import faults
+    from goworld_tpu.engine.aoi import AOIEngine
+    from goworld_tpu.engine.placement import PlacementController
+
+    faults.clear()
+    if plan is not None:
+        faults.install(plan)
+    eng = AOIEngine("cpu")
+    pc = PlacementController(eng)
+    h = eng._create_handle(cap, tier)
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0.0, world, cap).astype(np.float32)
+    z = rng.uniform(0.0, world, cap).astype(np.float32)
+    r = np.full(cap, 100.0, np.float32)
+    act = np.ones(cap, bool)
+    crc, n_events, walls, evac_tick = 0, 0, [], -1
+    for t in range(ticks):
+        x = x + rng.uniform(-3.0, 3.0, cap).astype(np.float32)
+        z = z + rng.uniform(-3.0, 3.0, cap).astype(np.float32)
+        if t == migrate_at and migrate_to is not None:
+            pc.migrate(h, migrate_to)
+        t0 = time.perf_counter()
+        eng.submit(h, x, z, r, act)
+        eng.flush()
+        e, lv = eng.take_events(h)
+        walls.append(time.perf_counter() - t0)
+        e = np.ascontiguousarray(e, np.int32)
+        lv = np.ascontiguousarray(lv, np.int32)
+        crc = zlib.crc32(lv.tobytes(), zlib.crc32(e.tobytes(), crc))
+        n_events += len(e) + len(lv)
+        if evac_tick < 0 and eng.migration_stats["evacuations"] > 0:
+            evac_tick = t
+    faults.clear()
+    return crc, walls, n_events, evac_tick, eng, h
+
+
+def bench_engine_failover(cfg, ticks=32, kill_at=16, cap=1024):
+    """Kill a chip mid-bench (docs/robustness.md "Live migration &
+    failover"): the same walk runs twice on a single-chip bucket --
+    uninterrupted (the parity oracle + steady throughput), then with
+    ``aoi.device:reset`` firing mid-walk (-> DeviceLost -> the bucket
+    self-heals the tick on its host mirror and evacuates every slot onto
+    a fresh same-tier bucket).  Records ticks-to-recover, events lost
+    (MUST be 0: crc32 parity over the delivered streams), and throughput
+    before/after the kill.  cap is clamped below the engine config's so
+    the O(cap^2) single-chip kernel stays cheap on CPU containers."""
+    clean_crc, clean_walls, clean_n, _e, _eng, _h = _resilience_walk(
+        cap, cfg.world, ticks, "tpu")
+    crc, walls, n_ev, evac_tick, eng, h = _resilience_walk(
+        cap, cfg.world, ticks, "tpu", plan=f"aoi.device:reset@{kill_at}")
+    warm = 3  # first ticks carry jit compilation on either side of the kill
+    kill = evac_tick if evac_tick >= 0 else kill_at - 1
+    pre = walls[warm:kill] or walls[:kill] or [walls[0]]
+    base = sorted(pre)[len(pre) // 2]
+    # recovered = per-tick wall back within 2x the pre-kill median; the
+    # evacuation tick itself (host self-heal + snapshot replay + fresh
+    # bucket) always counts, so ticks_to_recover >= 1 by construction
+    rec = kill + 1
+    while rec < len(walls) and walls[rec] > 2.0 * base:
+        rec += 1
+    post = walls[rec:] or [walls[-1]]
+    stats = eng.migration_stats
+    return {
+        "metric": "engine_failover",
+        "config": "engine_failover",
+        "kind": "chip-loss evacuation",
+        "value": round(cap * len(post) / sum(post)),
+        "unit": "moves/s",
+        "rate_kind": "e2e",
+        "detail": f"aoi.device:reset@{kill_at} on a single-chip bucket, "
+                  f"1 space x {cap} entities, {ticks} ticks, r=100.0, "
+                  f"world={cfg.world}; value = post-recovery throughput",
+        "n_entities": cap,
+        "ticks": ticks,
+        "kill_tick": kill,
+        "ticks_to_recover": rec - kill,
+        "recover_tick_ms": round(walls[kill] * 1e3, 2),
+        "events_lost": clean_n - n_ev,
+        "parity_ok": crc == clean_crc,
+        "parity_checksum": f"{crc:08x}",
+        "evacuations": stats["evacuations"],
+        "migrations": stats["migrations"],
+        "moves_per_sec_before": round(cap * len(pre) / sum(pre)),
+        "moves_per_sec_after": round(cap * len(post) / sum(post)),
+        "ms_per_tick": round(sum(post) / len(post) * 1e3, 2),
+        "final_tier": eng._tier_of(h.bucket),
+    }
+
+
+def bench_engine_migrate(cfg, ticks=32, migrate_at=12, cap=1024):
+    """Live migration under load (the placement controller's tentpole
+    path): the same walk runs unmigrated on the host oracle, then with a
+    host -> single-chip migration started mid-walk (snapshot -> replay ->
+    double-cover -> swap).  Every tick still delivers (dropped_ticks
+    MUST be 0) and the delivered streams stay crc32-identical."""
+    clean_crc, _w, clean_n, _e, _eng, _h = _resilience_walk(
+        cap, cfg.world, ticks, "cpu")
+    crc, walls, n_ev, _evac, eng, h = _resilience_walk(
+        cap, cfg.world, ticks, "cpu", migrate_to="tpu",
+        migrate_at=migrate_at)
+    stats = eng.migration_stats
+    return {
+        "metric": "engine_migrate",
+        "config": "engine_migrate",
+        "kind": "live migration cpu->tpu",
+        "value": round(cap * ticks / sum(walls)),
+        "unit": "moves/s",
+        "rate_kind": "e2e",
+        "detail": f"host -> single-chip live migration at tick "
+                  f"{migrate_at} of {ticks}, 1 space x {cap} entities, "
+                  f"r=100.0, world={cfg.world}; double-covered cover "
+                  f"flushes, ownership swap after crc parity",
+        "n_entities": cap,
+        "ticks": ticks,
+        "migrate_tick": migrate_at,
+        "dropped_ticks": ticks - len(walls),
+        "events_lost": clean_n - n_ev,
+        "parity_ok": crc == clean_crc,
+        "parity_checksum": f"{crc:08x}",
+        "migrations": stats["migrations"],
+        "migration_rollbacks": stats["migration_rollbacks"],
+        "migration_ms": round(stats["migration_ms"], 2),
+        "ms_per_tick": round(sum(walls) / len(walls) * 1e3, 2),
+        "final_tier": eng._tier_of(h.bucket),
+    }
 
 
 def bench_cpu(cfg, xs, zs):
@@ -1687,6 +1832,13 @@ def main():
             faults.check("bench.config")
             if cfg.name == "engine":
                 emit(bench_engine(cfg, "cpp"))
+                # robustness benches (docs/robustness.md "Live migration &
+                # failover"), platform-agnostic by design: kill-a-chip
+                # evacuation (ticks-to-recover, events_lost must be 0,
+                # throughput before/after) and a live migration under load
+                # (no dropped tick, crc parity, migration_ms)
+                emit(bench_engine_failover(cfg))
+                emit(bench_engine_migrate(cfg))
                 import jax
 
                 if jax.default_backend() != "tpu":
@@ -1791,6 +1943,14 @@ def main():
                          ("aoi_h2d_bytes_per_tick", "h2d_B"),
                          ("aoi_delta_hit_rate", "delta_hit"),
                          ("flush_sched", "sched"),
+                         ("ticks_to_recover", "t_rec"),
+                         ("events_lost", "ev_lost"),
+                         ("dropped_ticks", "drop_t"),
+                         ("evacuations", "evac"),
+                         ("migrations", "mig"),
+                         ("migration_ms", "mig_ms"),
+                         ("moves_per_sec_before", "mps_pre"),
+                         ("moves_per_sec_after", "mps_post"),
                          ("parity_checksum", "crc"),
                          ("span_tick_ms", "span_ms"),
                          ("host_other_ms", "host_ms")):

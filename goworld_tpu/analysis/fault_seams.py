@@ -10,7 +10,12 @@ only holds if the seam catalog stays honest.  Three ways it rots:
   does not declare -- the fault never fires (``FaultSpec.__post_init__``
   rejects unknown seams at plan-build time, so the plan cannot even name
   it) and the docstring table lies;
-* a seam is declared but no production code checks it -- dead catalog.
+* a seam is declared but no production code checks it -- dead catalog;
+* a bucket tier grows a recovery path (``_recover``) without the
+  evacuation/migration hooks (``export_snapshot`` / ``import_snapshot`` /
+  ``evacuate``) -- the chip-loss failover path (``aoi.device`` seam,
+  engine/placement.py) silently cannot re-home that tier's spaces, so a
+  lost device strands them despite the tier "supporting" faults.
 
 Mechanics mirror gate-coverage: the catalog is AST-extracted from
 faults.py (the ``SEAMS = {...}`` dict's string keys), usage is every
@@ -144,3 +149,29 @@ def check(ctx: Context):
             RULE, cat_sf.rel, line, 0,
             f"declared fault seam {seam!r} is checked nowhere in package "
             "code: dead catalog entry")
+
+    # bucket tiers that recover from device faults must also be
+    # evacuable/migratable: the aoi.device failover path rebuilds every
+    # slot through export_snapshot/import_snapshot/evacuate, so a tier
+    # with _recover but without the hooks strands its spaces on chip loss
+    _HOOKS = ("export_snapshot", "import_snapshot", "evacuate")
+    for sf in ctx.files:
+        base = os.path.basename(sf.rel)
+        if not (base == "aoi.py" or base.startswith("aoi_")) \
+                or "engine" not in sf.rel or sf.rel.startswith("tests/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            defined = {n.name for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            if "_recover" not in defined:
+                continue
+            missing = [h for h in _HOOKS if h not in defined]
+            if missing:
+                yield Finding(
+                    RULE, sf.rel, node.lineno, node.col_offset,
+                    f"bucket tier {node.name} defines _recover but lacks "
+                    f"{', '.join(missing)}: the aoi.device chip-loss "
+                    "failover cannot evacuate this tier's spaces")

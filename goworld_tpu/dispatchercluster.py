@@ -140,9 +140,15 @@ class DispatcherCluster:
     def status(self) -> list[dict]:
         """Per-dispatcher health snapshot."""
         out = []
+        now = time.monotonic()
         for i, s in enumerate(self._stats):
             d = dict(s)
-            d.pop("next_attempt")
+            # surface the backoff clock as "seconds until the next retry"
+            # (0 while connected / retry due) instead of the raw monotonic
+            # deadline, which is meaningless outside this process
+            d["next_retry_in"] = (
+                max(0.0, d.pop("next_attempt") - now)
+                if self.conns[i] is None else 0.0)
             d["connected"] = self.conns[i] is not None
             d["pending"] = len(self._pending[i])
             out.append(d)
@@ -164,6 +170,10 @@ class DispatcherCluster:
             out.append(Sample("disp.backoff_s", "gauge",
                               float(s["backoff_s"]), labels,
                               "current reconnect backoff"))
+            out.append(Sample("disp.next_retry_in", "gauge",
+                              float(s["next_retry_in"]), labels,
+                              "seconds until the next reconnect attempt "
+                              "(0 while connected)"))
             out.append(Sample("disp.pending", "gauge",
                               float(s["pending"]), labels,
                               "payloads buffered for outage replay"))

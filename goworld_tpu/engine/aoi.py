@@ -31,6 +31,7 @@ tests/test_aoi_native.py).
 from __future__ import annotations
 
 import time
+import weakref
 from dataclasses import dataclass
 
 import numpy as np
@@ -150,6 +151,49 @@ def _split_rows(tri: np.ndarray) -> dict[int, np.ndarray]:
         for s in np.unique(tri[:, 0]).tolist():
             out[s] = tri[tri[:, 0] == s][:, 1:]
     return out
+
+
+def _build_snapshot(capacity: int, x, z, r, act, sub: bool,
+                    words: np.ndarray) -> dict:
+    """One space's live-migration wire image (docs/robustness.md).
+
+    Positions travel as a delta-staging packet (ops/aoi_stage.pad_packet --
+    PR 2's H2D wire format doubles as the migration serialization), rows all
+    zero because the importer scatters into its own slot row; the pow2
+    padding duplicates the last entry, which an assignment scatter absorbs
+    idempotently.  ``words`` is the previous-tick packed interest state --
+    the only other durable truth a tier needs to resume bit-exactly.
+    Pending events are NOT part of the snapshot: the migration swap and the
+    evacuation path carry them explicitly (delivery, not state)."""
+    from ..ops import aoi_stage as AS
+
+    # Snapshot export runs between ticks (a migration/evacuation event,
+    # not the flush hot path); the inputs are host shadows already, so
+    # asarray only normalizes dtype and pad_packet is numpy-in/numpy-out.
+    x = np.asarray(x, np.float32)  # gwlint: allow[host-sync] -- host shadow
+    z = np.asarray(z, np.float32)  # gwlint: allow[host-sync] -- host shadow
+    nz = np.nonzero((x.view(np.uint32) != 0) | (z.view(np.uint32) != 0))[0]
+    pkt = None
+    if len(nz):
+        pkt = tuple(np.asarray(a) for a in AS.pad_packet(  # gwlint: allow[host-sync] -- migration-time packet build
+            np.zeros(len(nz), np.int64), nz, x[nz], z[nz]))
+    return {"capacity": capacity, "packet": pkt,
+            "r": np.array(r, np.float32, copy=True),
+            "act": np.array(act, bool, copy=True),
+            "sub": bool(sub),
+            "words": np.array(words, np.uint32, copy=True)}
+
+
+def _unpack_positions(snap: dict) -> tuple[np.ndarray, np.ndarray]:
+    """Scatter a snapshot's packet back into dense [C] x/z arrays."""
+    c = snap["capacity"]
+    x = np.zeros(c, np.float32)
+    z = np.zeros(c, np.float32)
+    if snap["packet"] is not None:
+        _rows, cols, xv, zv = snap["packet"]
+        x[cols] = xv
+        z[cols] = zv
+    return x, z
 
 
 def _demote_emit(bucket, e: BaseException) -> None:
@@ -412,7 +456,7 @@ class _TriCapDecay:
         return None
 
 
-@dataclass
+@dataclass(eq=False)  # identity hash: handles live in a WeakSet registry
 class SpaceAOIHandle:
     backend: str        # resolved (cpu | cpp | tpu)
     capacity: int
@@ -484,6 +528,16 @@ class AOIEngine:
         # the mesh bucket implements the same contract per chip)
         self.pipeline = pipeline
         self._buckets: dict[tuple[str, int], _Bucket] = {}
+        # live handle registry (weak: a dropped Space must not pin its
+        # slot); the chip-loss evacuation path re-points these in place so
+        # Spaces survive their bucket dying (docs/robustness.md)
+        self._handles: "weakref.WeakSet[SpaceAOIHandle]" = weakref.WeakSet()
+        # in-flight live migrations (engine/placement.py _Migration
+        # objects); flush() drives their per-flush double-cover compare
+        self._migrations: list = []
+        self.migration_stats = {"migrations": 0, "evacuations": 0,
+                                "migration_rollbacks": 0,
+                                "migration_ms": 0.0}
         # unified telemetry: the per-bucket stats/perf dicts surface at
         # /debug/metrics under aoi.* dotted names.  Registered weakly so
         # the registry never keeps a dead engine (and its device state)
@@ -603,8 +657,62 @@ class AOIEngine:
                 raise ValueError(f"unknown AOI backend {backend!r}")
             self._buckets[key] = bucket
         slot = bucket.acquire_slot()
-        return SpaceAOIHandle(backend, capacity, bucket, slot,
-                              requested=requested)
+        h = SpaceAOIHandle(backend, capacity, bucket, slot,
+                           requested=requested)
+        self._handles.add(h)
+        return h
+
+    def _create_handle(self, capacity: int, tier: str) -> SpaceAOIHandle:
+        """Acquire a slot on an EXPLICIT bucket tier (``cpu`` | ``cpp`` |
+        ``tpu`` | ``mesh`` | ``rowshard``) -- the placement controller's
+        entry point: capacity routing is create_space's job, but a
+        migration target chosen by scoring must land exactly where the
+        controller said.  ``tier="tpu"`` means the single-chip bucket even
+        on a mesh engine (keyed ``tpu-single`` so it never collides with
+        the mesh bucket at the same capacity)."""
+        capacity = P.round_capacity(capacity)
+        if tier in ("cpu", "cpp"):
+            return self.create_space(capacity, tier)
+        if tier == "rowshard":
+            if self.mesh is None or capacity % (self.mesh.n_devices * 128):
+                raise ValueError(
+                    f"capacity {capacity} cannot row-shard on this engine")
+            from .aoi_rowshard import _RowShardTPUBucket
+
+            bucket = _RowShardTPUBucket(
+                capacity, self.mesh, pipeline=self.pipeline,
+                delta_staging=self.delta_staging, emit=self._resolve_emit())
+            self._rowshard_serial += 1
+            self._buckets[(f"tpu-rowshard-{self._rowshard_serial}",
+                           capacity)] = bucket
+        elif tier == "mesh":
+            if self.mesh is None:
+                raise ValueError("tier='mesh' requires a mesh engine")
+            key = ("tpu", capacity)
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                from .aoi_mesh import _MeshTPUBucket
+
+                bucket = _MeshTPUBucket(
+                    capacity, self.mesh, pipeline=self.pipeline,
+                    delta_staging=self.delta_staging,
+                    emit=self._resolve_emit())
+                self._buckets[key] = bucket
+        elif tier == "tpu":
+            key = (("tpu-single", capacity) if self.mesh is not None
+                   else ("tpu", capacity))
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = _TPUBucket(capacity, pipeline=self.pipeline,
+                                    delta_staging=self.delta_staging,
+                                    emit=self._resolve_emit())
+                self._buckets[key] = bucket
+        else:
+            raise ValueError(f"unknown placement tier {tier!r}")
+        slot = bucket.acquire_slot()
+        h = SpaceAOIHandle("tpu", capacity, bucket, slot, requested="tpu")
+        self._handles.add(h)
+        return h
 
     def _resolve_emit(self) -> str:
         """Resolve the requested emit mode once (an explicit/auto "native"
@@ -615,6 +723,11 @@ class AOIEngine:
         return self._emit_resolved
 
     def release_space(self, h: SpaceAOIHandle) -> None:
+        mig = getattr(h, "_migration", None)
+        if mig is not None:
+            # a space released mid-cover rolls its migration back first --
+            # the target slot must not outlive the space
+            mig.abort("space released mid-cover")
         if not h.released:
             h.bucket.release_slot(h.slot)
             h.released = True
@@ -629,6 +742,11 @@ class AOIEngine:
         """Stage one space's tick inputs (numpy arrays of length <= capacity)."""
         if h.released:
             raise ValueError("space AOI handle already released")
+        mig = getattr(h, "_migration", None)
+        if mig is not None:
+            # double-cover: the migration target computes the same ticks
+            # from the same inputs until CRC parity confirms the replay
+            mig.on_submit(x, z, radius, active)
         h.bucket.stage(h.slot, (x, z, radius, active))
 
     def flush(self) -> None:
@@ -646,18 +764,82 @@ class AOIEngine:
         independent of space-creation interleaving.  ``flush_sched=False``
         forces the sequential baseline: each bucket dispatches AND
         harvests before the next starts."""
+        for m in list(self._migrations):
+            m.on_flush_begin()
         buckets = [self._buckets[k] for k in sorted(self._buckets)]
         if not self.flush_sched:
             for bucket in buckets:
                 bucket.dispatch()
                 bucket.harvest()
-            return
-        with _T.span("aoi.dispatch"):
-            for bucket in buckets:
-                bucket.dispatch()
-        with _T.span("aoi.harvest"):
-            for bucket in buckets:
-                bucket.harvest()
+        else:
+            with _T.span("aoi.dispatch"):
+                for bucket in buckets:
+                    bucket.dispatch()
+            with _T.span("aoi.harvest"):
+                for bucket in buckets:
+                    bucket.harvest()
+        if self._migrations:
+            # double-cover verification: compare the event deltas both
+            # homes produced this flush; swap/abort decisions happen here
+            with _T.span("aoi.migrate.cover"):
+                for m in list(self._migrations):
+                    m.on_flush_end()
+        evacuating = [k for k, b in self._buckets.items()
+                      if getattr(b, "_evacuating", False)]
+        for key in sorted(evacuating):
+            self._evacuate_bucket(key)
+
+    # -- chip-loss failover (docs/robustness.md) --------------------------
+
+    @staticmethod
+    def _tier_of(bucket) -> str:
+        """Placement tier of a live bucket (the _create_handle vocabulary)."""
+        if getattr(bucket, "exclusive", False):
+            return "rowshard"
+        name = type(bucket).__name__
+        if name == "_MeshTPUBucket":
+            return "mesh"
+        if name == "_TPUBucket":
+            return "tpu"
+        return ("cpu" if getattr(bucket, "_oracle_cls", None) is CPUAOIOracle
+                else "cpp")
+
+    def _evacuate_bucket(self, key) -> None:
+        """The bucket's chip is LOST (``aoi.device`` seam, kind ``reset``
+        -> faults.DeviceLost).  Its in-flight tick was already recovered
+        host-side from (mirror, shadows) by the tier's ``_recover`` -- the
+        bucket's host state IS the truth -- so rebuild every live space
+        onto a fresh bucket of the same tier (a surviving device) through
+        the snapshot/import machinery, carry undelivered events, and
+        re-point the handles in place: no restart, no dropped tick, no
+        lost or duplicated enter/leave events."""
+        bucket = self._buckets[key]
+        t0 = time.perf_counter()
+        with _T.span("aoi.evacuate"):
+            for m in [m for m in self._migrations
+                      if m.h.bucket is bucket or m.t.bucket is bucket]:
+                m.abort("bucket evacuating after device loss")
+            tier = self._tier_of(bucket)
+            snaps = bucket.evacuate()
+            del self._buckets[key]
+            owners = {h.slot: h for h in self._handles
+                      if h.bucket is bucket and not h.released}
+            for slot in sorted(snaps):
+                h = owners.get(slot)
+                if h is None:
+                    continue  # no live Space behind the slot: nothing to save
+                nh = self._create_handle(h.capacity, tier)
+                nh.bucket.import_snapshot(nh.slot, snaps[slot])
+                pending = bucket._events.pop(slot, None)
+                if pending is not None:
+                    nh.bucket._events[nh.slot] = pending
+                # atomic ownership swap: the Space's handle object never
+                # changes, it just points at the new home
+                h.bucket, h.slot = nh.bucket, nh.slot
+                nh.released = True  # shell handle; h owns the slot now
+        self.migration_stats["evacuations"] += 1
+        self.migration_stats["migration_ms"] += (
+            time.perf_counter() - t0) * 1e3
 
     def has_pending(self) -> bool:
         """True when a pipelined bucket holds a dispatched-but-unharvested
@@ -704,6 +886,17 @@ class AOIEngine:
             out.append(Sample("aoi." + k.replace("_s", "_seconds"), "counter",
                               perf[k], lbl,
                               "cumulative per-phase flush time"))
+        ms = self.migration_stats
+        out.append(Sample("aoi.migrations", "counter", ms["migrations"], lbl,
+                          "completed live space migrations"))
+        out.append(Sample("aoi.evacuations", "counter", ms["evacuations"],
+                          lbl, "bucket evacuations after chip loss"))
+        out.append(Sample("aoi.migration_rollbacks", "counter",
+                          ms["migration_rollbacks"], lbl,
+                          "migrations aborted back to their source bucket"))
+        out.append(Sample("aoi.migration_ms", "counter",
+                          ms["migration_ms"], lbl,
+                          "cumulative migration/evacuation wall time (ms)"))
         return out
 
     def take_events(self, h: SpaceAOIHandle):
@@ -715,6 +908,9 @@ class AOIEngine:
         _Bucket.set_subscribed).  Spaces whose entities are all plain opt
         out: device backends then skip their extraction/fetch/decode
         entirely and their interest state is derived on demand."""
+        mig = getattr(h, "_migration", None)
+        if mig is not None:  # keep the double-cover target in lockstep
+            mig.t.bucket.set_subscribed(mig.t.slot, flag)
         h.bucket.set_subscribed(h.slot, flag)
 
     def clear_entity(self, h: SpaceAOIHandle, entity_slot: int) -> None:
@@ -723,6 +919,9 @@ class AOIEngine:
         severs its interest pairs synchronously (departure events must fire
         the same tick), so the calculator must not re-emit them as diffs --
         and a reused slot must start clean."""
+        mig = getattr(h, "_migration", None)
+        if mig is not None:  # keep the double-cover target in lockstep
+            mig.t.bucket.clear_entity(mig.t.slot, entity_slot)
         h.bucket.clear_entity(h.slot, entity_slot)
 
     def grow_space(self, h: SpaceAOIHandle, new_capacity: int) -> SpaceAOIHandle:
@@ -736,6 +935,11 @@ class AOIEngine:
         new_capacity = P.round_capacity(new_capacity)
         if new_capacity <= h.capacity:
             raise ValueError("grow_space requires a larger capacity")
+        mig = getattr(h, "_migration", None)
+        if mig is not None:
+            # growth changes the packed layout mid-cover; roll the
+            # migration back (zero loss) and let the controller retry
+            mig.abort("space grown mid-cover")
         old_words = h.bucket.get_prev(h.slot)
         ratio = new_capacity // h.capacity
         if new_capacity == h.capacity * ratio and ratio & (ratio - 1) == 0:
@@ -872,6 +1076,11 @@ class _CPUBucket(_Bucket):
         self.algorithm = algorithm
         self._oracle_cls = oracle_cls
         self._oracles: list = []
+        # last flushed inputs per slot (REFERENCES, not copies -- the host
+        # hot path must not pay per-tick array copies; export_snapshot
+        # copies on demand).  The migration snapshot's position packet is
+        # built from these.
+        self._last: dict[int, tuple] = {}
         # phase-attribution counters (seconds, cumulative; bench_engine
         # reads deltas) -- a perf_counter pair per flush, noise-level cost
         self.perf = {"calc_s": 0.0}
@@ -884,15 +1093,50 @@ class _CPUBucket(_Bucket):
 
     def _reset_slot(self, slot: int) -> None:
         self._oracles[slot].reset()
+        self._last.pop(slot, None)
 
     def flush(self) -> None:
         t0 = time.perf_counter()
         _ts = _T.t()
         for slot, (x, z, r, act) in self._staged.items():
             self._events[slot] = self._oracles[slot].step(x, z, r, act)
+            self._last[slot] = (x, z, r, act)
         self._staged.clear()
         _T.lap("aoi.kernel", _ts)
         self.perf["calc_s"] += time.perf_counter() - t0
+
+    def export_snapshot(self, slot: int) -> dict:
+        """Live-migration wire image of one slot (docs/robustness.md): the
+        last flushed inputs as a delta-staging packet + the previous-tick
+        interest words.  Inputs are the staged REFERENCES -- callers
+        migrate between ticks, after flush and before the next submit, so
+        the arrays still hold the flushed values."""
+        last = self._last.get(slot)
+        if last is None:
+            c = self.capacity
+            last = (np.zeros(c, np.float32), np.zeros(c, np.float32),
+                    np.zeros(c, np.float32), np.zeros(c, bool))
+        x, z, r, act = last
+        xx = np.zeros(self.capacity, np.float32)
+        zz = np.zeros(self.capacity, np.float32)
+        rr = np.zeros(self.capacity, np.float32)
+        aa = np.zeros(self.capacity, bool)
+        n = len(x)
+        xx[:n], zz[:n], rr[:n], aa[:n] = x, z, r, act
+        return _build_snapshot(self.capacity, xx, zz, rr, aa, True,
+                               self._oracles[slot].prev_words)
+
+    def import_snapshot(self, slot: int, snap: dict) -> None:
+        """Replay a migration snapshot onto this slot: reconstruct the
+        input arrays from the packet (so a later re-export round-trips)
+        and seed the oracle's previous-tick words."""
+        if snap["capacity"] != self.capacity:
+            raise ValueError(
+                f"snapshot capacity {snap['capacity']} != bucket "
+                f"capacity {self.capacity}")
+        x, z = _unpack_positions(snap)
+        self._last[slot] = (x, z, snap["r"].copy(), snap["act"].copy())
+        self.set_prev(slot, snap["words"])
 
     def peek_words(self, slot: int) -> np.ndarray:
         return self._oracles[slot].prev_words
@@ -1027,6 +1271,10 @@ class _TPUBucket(_Bucket):
         # recovers via a best-effort prev fetch / shadow recompute.)
         self._ft = faults.active()
         self._need_rebuild = False   # device prev dropped; re-upload next flush
+        # chip-loss failover: True after a DeviceLost recovery -- the
+        # engine rebuilds every live slot onto a fresh bucket at the end
+        # of the current flush (docs/robustness.md)
+        self._evacuating = False
         # calculator fallback chain: 0 = platform default (pallas on TPU),
         # 1 = dense formulation, 2 = host oracle (device never touched).
         # Each kernel-phase fault demotes one level; reset_calc_chain()
@@ -1235,6 +1483,8 @@ class _TPUBucket(_Bucket):
             if not _device_fault(e):
                 raise
             self._recover(e)
+            if isinstance(e, faults.DeviceLost):
+                self._mark_evacuating()
 
     def harvest(self) -> None:
         """Phase 2: block on whatever :meth:`dispatch` parked -- the D2H
@@ -1269,6 +1519,11 @@ class _TPUBucket(_Bucket):
 
         c = self.capacity
         self._fault_phase = "stage"
+        # device health probe: kind ``reset`` = the chip is LOST
+        # (faults.DeviceLost) -- recovery must land on a different device,
+        # so dispatch()'s handler marks the bucket evacuating after the
+        # standard host-side tick recovery
+        faults.check("aoi.device")
         self._rebuild_device()
         if self._pending_reset:
             idx = jnp.asarray(sorted(self._pending_reset), jnp.int32)
@@ -2168,4 +2423,52 @@ class _TPUBucket(_Bucket):
         self._mirror_stale.discard(slot)  # mirror row set to truth below
         if self._mirror is not None:
             self._mirror[slot] = w
+
+    # -- live migration & chip-loss failover (docs/robustness.md) --------
+
+    def _mark_evacuating(self) -> None:
+        """The device is LOST (faults.DeviceLost): never touch it again.
+        Host-oracle mode (calc level 2) keeps the bucket serving bit-exact
+        ticks from (mirror, shadows) until the engine rebuilds its spaces
+        onto a fresh bucket at the end of the current flush."""
+        self._evacuating = True
+        self._calc_level = 2
+        self.stats["calc_level"] = 2
+        self._need_rebuild = False  # there is no device to rebuild onto
+
+    def export_snapshot(self, slot: int) -> dict:  # gwlint: allow[host-sync] -- migration snapshot, off the steady tick path
+        """Live-migration wire image of one slot: the input shadows as a
+        delta-staging packet + the previous-tick interest words.  Drains
+        any pipelined in-flight tick first so the delivered event stream
+        and the snapshot agree (double-cover alignment)."""
+        self.drain()
+        return _build_snapshot(
+            self.capacity, self._hx[slot], self._hz[slot], self._hr[slot],
+            self._hact[slot], bool(self._hsub[slot]), self.get_prev(slot))
+
+    def import_snapshot(self, slot: int, snap: dict) -> None:  # gwlint: allow[host-sync] -- migration replay, off the steady tick path
+        """Replay a migration snapshot onto this slot: scatter the packet
+        into the input shadows (device copies invalidated -> the next
+        flush full-restages) and seed prev from the words.  Bit-exact with
+        the source tier: shadows are the durable truth everywhere (the
+        delta-staging contract)."""
+        if snap["capacity"] != self.capacity:
+            raise ValueError(
+                f"snapshot capacity {snap['capacity']} != bucket "
+                f"capacity {self.capacity}")
+        x, z = _unpack_positions(snap)
+        self._hx[slot] = x
+        self._hz[slot] = z
+        self._hr[slot] = snap["r"]
+        self._hact[slot] = snap["act"]
+        self.set_subscribed(slot, snap["sub"])
+        self._dev_stale.update(("xz", "ra", "sub"))
+        self.set_prev(slot, snap["words"])
+
+    def evacuate(self) -> dict[int, dict]:
+        """Snapshot every occupied slot for rebuild on a surviving device
+        (the engine drives this after a DeviceLost recovery marked the
+        bucket evacuating)."""
+        live = sorted(set(range(self.n_slots)) - set(self._free))
+        return {slot: self.export_snapshot(slot) for slot in live}
 

@@ -65,8 +65,9 @@ from ..telemetry import trace as _T
 from ..ops import aoi_emit as AE
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
-from .aoi import (_Bucket, _CapDecay, _device_fault, _emit_expand,
-                  _kernelish_fault, _packed_predicate, _split_rows)
+from .aoi import (_Bucket, _CapDecay, _build_snapshot, _device_fault,
+                  _emit_expand, _kernelish_fault, _packed_predicate,
+                  _split_rows, _unpack_positions)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -143,6 +144,10 @@ class _MeshTPUBucket(_Bucket):
         # device loss always has a durable copy to rebuild from
         self._ft = faults.active()
         self._need_rebuild = False
+        # chip-loss failover: True after a DeviceLost recovery -- the
+        # engine rebuilds every live slot onto a fresh bucket at the end
+        # of the current flush (docs/robustness.md)
+        self._evacuating = False
         self._calc_level = 0  # 0 = platform default, 1 = dense, 2 = oracle
         self._fault_phase = "stage"
         self._cur_slots: list[int] = []
@@ -347,6 +352,54 @@ class _MeshTPUBucket(_Bucket):
         w, b = P.word_bit_for_column(entity_slot, self.capacity)
         self._mirror[slot, :, w] &= np.uint32(
             ~(np.uint32(1) << np.uint32(b)) & 0xFFFFFFFF)
+
+    # -- live migration & chip-loss failover (docs/robustness.md) ----------
+
+    def _mark_evacuating(self) -> None:
+        """The mesh shard holding this bucket is LOST (faults.DeviceLost):
+        never touch the device again.  Host-oracle mode keeps the bucket
+        serving bit-exact ticks from (mirror, shadows) until the engine
+        rebuilds its spaces onto a fresh bucket at the end of the flush."""
+        self._evacuating = True
+        self._calc_level = 2
+        self.stats["calc_level"] = 2
+        self._need_rebuild = False  # there is no device to rebuild onto
+
+    def export_snapshot(self, slot: int) -> dict:  # gwlint: allow[host-sync] -- migration snapshot, off the steady tick path
+        """Live-migration wire image of one slot (see
+        _TPUBucket.export_snapshot; drains the pipeline first so the
+        delivered stream and the snapshot agree)."""
+        self.drain()
+        return _build_snapshot(
+            self.capacity, self._hx[slot], self._hz[slot], self._hr[slot],
+            self._hact[slot], bool(self._hsub[slot]), self.get_prev(slot))
+
+    def import_snapshot(self, slot: int, snap: dict) -> None:  # gwlint: allow[host-sync] -- migration replay, off the steady tick path
+        """Replay a migration snapshot onto this slot (see
+        _TPUBucket.import_snapshot).  set_prev marks the slot
+        seeded-but-unstaged: the space MUST stage before the next flush
+        (the migration cover and the evacuation re-point both guarantee a
+        submit every tick)."""
+        if snap["capacity"] != self.capacity:
+            raise ValueError(
+                f"snapshot capacity {snap['capacity']} != bucket "
+                f"capacity {self.capacity}")
+        x, z = _unpack_positions(snap)
+        self._hx[slot] = x
+        self._hz[slot] = z
+        self._hr[slot] = snap["r"]
+        self._hact[slot] = snap["act"]
+        self.set_subscribed(slot, snap["sub"])
+        self._xz_stale = True  # device x/z copies diverged: full restage
+        self._h2d_cache.clear()
+        self.set_prev(slot, snap["words"])
+
+    def evacuate(self) -> dict[int, dict]:
+        """Snapshot every occupied slot for rebuild on surviving devices
+        (the engine drives this after a DeviceLost recovery marked the
+        bucket evacuating)."""
+        live = sorted(set(range(self.n_slots)) - set(self._free))
+        return {slot: self.export_snapshot(slot) for slot in live}
 
     # -- jitted helpers (sharding pinned, no host round-trips) -------------
     def _set_slot_fn(self):
@@ -642,6 +695,8 @@ class _MeshTPUBucket(_Bucket):
             if not _device_fault(e):
                 raise
             self._recover(e)
+            if isinstance(e, faults.DeviceLost):
+                self._mark_evacuating()
 
     def harvest(self) -> None:
         """Phase 2 of the split flush: the blocking fetch + decode of what
@@ -672,6 +727,10 @@ class _MeshTPUBucket(_Bucket):
         t0 = time.perf_counter()
         _ts = _T.t()
         self._fault_phase = "stage"
+        # device health probe: kind ``reset`` = the chip is LOST
+        # (faults.DeviceLost; dispatch()'s handler marks the bucket
+        # evacuating after the standard host-side recovery)
+        faults.check("aoi.device")
         if self.pipeline and self._inflight is not None \
                 and not self._inflight.get("all_unsub") \
                 and not self._inflight.get("host"):

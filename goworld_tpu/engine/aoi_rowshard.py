@@ -51,8 +51,9 @@ from ..telemetry import trace as _T
 from ..ops import aoi_predicate as P
 from ..ops import events as EV
 from ..ops import aoi_emit as AE
-from .aoi import (_Bucket, _CapDecay, _device_fault, _emit_expand,
-                  _kernelish_fault, _packed_predicate)
+from .aoi import (_Bucket, _CapDecay, _build_snapshot, _device_fault,
+                  _emit_expand, _kernelish_fault, _packed_predicate,
+                  _unpack_positions)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -116,6 +117,10 @@ class _RowShardTPUBucket(_Bucket):
         # _seed_prev covers under an active plan) plus _host_prev, the
         # recovered state carried host-side while the device is down
         self._ft = faults.active()
+        # chip-loss failover: True after a DeviceLost recovery -- the
+        # engine rebuilds the space onto a fresh bucket at the end of the
+        # current flush (docs/robustness.md)
+        self._evacuating = False
         self._calc_level = 0  # 0 = platform default, 1 = dense, 2 = oracle
         self._fault_phase = "stage"
         self._seed_prev: np.ndarray | None = None
@@ -457,6 +462,8 @@ class _RowShardTPUBucket(_Bucket):
             if not _device_fault(e):
                 raise
             self._recover(e)
+            if isinstance(e, faults.DeviceLost):
+                self._mark_evacuating()
 
     def harvest(self) -> None:
         """Phase 2 of the split flush: the blocking per-chip fetch + decode
@@ -500,6 +507,10 @@ class _RowShardTPUBucket(_Bucket):
 
     def _dispatch_device(self) -> None:
         self._fault_phase = "stage"
+        # device health probe: kind ``reset`` = the chip is LOST
+        # (faults.DeviceLost; dispatch()'s handler marks the bucket
+        # evacuating after the standard host-side recovery)
+        faults.check("aoi.device")
         self._apply_maintenance()
         if not self._staged:
             return
@@ -889,6 +900,56 @@ class _RowShardTPUBucket(_Bucket):
             # it host-side while a fault plan is active
             self._seed_prev = words.copy()
         self.prev = self.mesh.device_put(words)
+
+    # -- live migration & chip-loss failover (docs/robustness.md) ----------
+
+    def _mark_evacuating(self) -> None:
+        """The shard's devices are LOST (faults.DeviceLost): never touch
+        them again.  Host-oracle mode keeps the bucket serving bit-exact
+        ticks from (_host_prev, shadows) until the engine rebuilds the
+        space onto a fresh bucket at the end of the current flush."""
+        self._evacuating = True
+        self._calc_level = 2
+        self.stats["calc_level"] = 2
+
+    def export_snapshot(self, slot: int) -> dict:  # gwlint: allow[host-sync] -- migration snapshot, off the steady tick path
+        """Live-migration wire image of THE slot: the 1-D input shadows as
+        a delta-staging packet + the previous-tick interest words (see
+        _TPUBucket.export_snapshot; this bucket's flush is synchronous, so
+        there is no pipeline to drain)."""
+        return _build_snapshot(self.capacity, self._hx, self._hz, self._hr,
+                               self._hact, self._subscribed,
+                               self.get_prev(slot))
+
+    def import_snapshot(self, slot: int, snap: dict) -> None:  # gwlint: allow[host-sync] -- migration replay, off the steady tick path
+        """Replay a migration snapshot onto this bucket (see
+        _TPUBucket.import_snapshot; shadows here are 1-D, one space)."""
+        if snap["capacity"] != self.capacity:
+            raise ValueError(
+                f"snapshot capacity {snap['capacity']} != bucket "
+                f"capacity {self.capacity}")
+        x, z = _unpack_positions(snap)
+        self._hx[:] = x
+        self._hz[:] = z
+        self._hr[:] = snap["r"]
+        self._hact[:] = snap["act"]
+        self.set_subscribed(slot, snap["sub"])
+        self._xz_stale = True  # device x/z copies diverged: full restage
+        self._h2d_cache.clear()
+        self.set_prev(slot, snap["words"])
+        if self._ft:
+            # set_prev parked the words host-side (device state is lazy)
+            # and dropped the seed; under an active plan the seed is the
+            # exact recovery base for a fault on the first post-import
+            # tick (prev != predicate(shadows) until that tick lands)
+            self._seed_prev = np.ascontiguousarray(snap["words"], np.uint32)
+
+    def evacuate(self) -> dict[int, dict]:
+        """Snapshot the (single) occupied slot for rebuild on surviving
+        devices (the engine drives this after a DeviceLost recovery
+        marked the bucket evacuating)."""
+        live = sorted(set(range(self.n_slots)) - set(self._free))
+        return {slot: self.export_snapshot(slot) for slot in live}
 
     def peek_words(self, slot: int):
         return None  # no host mirror at this size; use derive_row/derive_col

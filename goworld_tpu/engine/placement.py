@@ -1,0 +1,379 @@
+"""Telemetry-driven placement: live space migration between AOI tiers.
+
+ROADMAP item 3's elasticity story: bucket->tier placement used to be static
+config, so a hot space could not leave an overloaded chip and a lost chip
+took its spaces down until restart.  This module adds both halves:
+
+  * :class:`PlacementController` -- scores each bucket's placement from the
+    same per-bucket load counters the telemetry registry exports (flush
+    seconds, entity counts, staged H2D bytes) and, in ``auto`` mode, picks
+    at most one space per cooldown window to re-home;
+
+  * :class:`_Migration` -- the live-migration state machine
+    (docs/robustness.md):
+
+        snapshot -> replay -> double-cover -> swap
+                                  |
+                                  +-> rollback (zero loss)
+
+    The source slot's host shadows are exported as a delta-staging packet
+    (ops/aoi_stage -- PR 2's H2D wire format doubles as the migration
+    serialization) and replayed onto the target bucket.  Then both homes
+    compute every tick from the same staged inputs while events keep
+    publishing from the SOURCE; each flush the two freshly-appended event
+    deltas are compared (CRC over the packed pairs + bit-exact array
+    compare, cadence-aligned when exactly one side is pipelined).  Once
+    enough aligned flushes verify, ownership swaps atomically: the handle
+    object the Space holds is re-pointed in place, undelivered events are
+    carried so no enter/leave is lost or duplicated and no tick is
+    dropped, and the source slot's epoch bump silences any still-in-flight
+    source tick.  Any mismatch -- or any fault recovery on the target
+    during the cover (a degraded target recomputes bit-exactly, so CRC
+    alone cannot catch it) -- rolls back to the source with zero loss.
+
+The chip-loss failover path (``aoi.device`` fault seam, kind ``reset``)
+reuses the same snapshot/import machinery: see AOIEngine._evacuate_bucket.
+
+Cadence rule: during a cover the space's events must be consumed every
+tick (the runtime's normal take_events cadence); migrating between a
+pipelined and an unpipelined tier shifts delivery by the one documented
+pipeline tick, never losing or duplicating events.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..telemetry import trace as _T
+
+__all__ = ["PlacementController", "LoadSample", "MigrationError"]
+
+_EMPTY = np.empty((0, 2), np.int32)
+
+
+class MigrationError(RuntimeError):
+    """A migration could not be started (bad handle / target tier)."""
+
+
+def _lag(bucket) -> int:
+    """Event-delivery lag of a bucket in flushes: 1 for a pipelined device
+    bucket (events one tick late), else 0.  The row-sharded bucket accepts
+    ``pipeline`` for symmetry but flushes synchronously (no ``_inflight``),
+    and host buckets publish inline."""
+    return 1 if (getattr(bucket, "pipeline", False)
+                 and hasattr(bucket, "_inflight")) else 0
+
+
+def _crc_pair(d) -> int:
+    crc = zlib.crc32(np.ascontiguousarray(d[0], np.int32).tobytes())
+    return zlib.crc32(np.ascontiguousarray(d[1], np.int32).tobytes(), crc)
+
+
+def _target_fault_count(bucket) -> int:
+    st = getattr(bucket, "stats", None)
+    if st is None:
+        return 0
+    return (st.get("rebuilds", 0) + st.get("fallbacks", 0)
+            + st.get("host_ticks", 0))
+
+
+class _Migration:
+    """One live migration in its double-cover phase.
+
+    Created by :meth:`PlacementController.migrate` AFTER snapshot+replay;
+    registered on the engine, which calls :meth:`on_flush_begin` /
+    :meth:`on_flush_end` around every flush and forwards submits and
+    maintenance to the target while the cover runs.
+    """
+
+    def __init__(self, engine, handle, target):
+        self.engine = engine
+        self.h = handle          # source: still owns delivery
+        self.t = target          # replayed shell handle
+        self.lag_s = _lag(handle.bucket)
+        self.lag_t = _lag(target.bucket)
+        # aligned verified comparisons before the swap.  With both sides
+        # pipelined the first aligned pair is the trivially-empty warmup
+        # flush, so one more is required to cover a real tick.
+        self.need = 1 + min(self.lag_s, self.lag_t)
+        self.verified = 0
+        self.src_seq: list = []  # per-flush (enter, leave) deltas
+        self.tgt_seq: list = []
+        self.crc = 0             # running CRC over the verified deltas
+        self.done = False
+        self._src_pre = None
+        self._t_faults0 = _target_fault_count(target.bucket)
+        self.t0 = time.perf_counter()
+
+    # -- engine hooks -----------------------------------------------------
+
+    def on_submit(self, x, z, radius, active) -> None:
+        """Duplicate the source's staged tick onto the target (double
+        compute: same inputs, both homes)."""
+        self.t.bucket.stage(self.t.slot, (x, z, radius, active))
+
+    def on_flush_begin(self) -> None:
+        # publish REPLACES a slot's pending tuple (callers consume every
+        # tick), so "what did this flush publish" is an identity question:
+        # a fresh tuple at flush end IS the flush's delta
+        self._src_pre = self.h.bucket._events.get(self.h.slot)
+
+    def on_flush_end(self) -> None:
+        if self.done:
+            return
+        cur = self.h.bucket._events.get(self.h.slot)
+        ds = cur if (cur is not None and cur is not self._src_pre) \
+            else (_EMPTY, _EMPTY)
+        # the target's published copies are DUPLICATES while the source
+        # owns delivery: consume them into the cover buffer so they can
+        # neither leak to the caller nor be silently replaced unseen
+        dt_ = self.t.bucket._events.pop(self.t.slot, None)
+        if dt_ is None:
+            dt_ = (_EMPTY, _EMPTY)
+        self.src_seq.append((np.asarray(ds[0]), np.asarray(ds[1])))
+        self.tgt_seq.append((np.asarray(dt_[0]), np.asarray(dt_[1])))
+        if _target_fault_count(self.t.bucket) != self._t_faults0:
+            # the target absorbed a device fault mid-cover.  Its recovery
+            # is bit-exact (the deltas still match), but a home that
+            # faulted during its own audition is not a home to adopt --
+            # and the bench's rollback contract (aoi.h2d:oom mid-cover
+            # -> source keeps serving, zero loss) keys off exactly this.
+            self.abort("target bucket faulted during cover")
+            return
+        k = len(self.src_seq)
+        L = self.lag_t - self.lag_s
+        if L >= 0:
+            i, j = k - 1 - L, k - 1     # src index partnered with newest tgt
+            lead = self.tgt_seq[j] if i < 0 else None
+        else:
+            i, j = k - 1, k - 1 + L     # newest src partnered with older tgt
+            lead = self.src_seq[i] if j < 0 else None
+        if lead is not None:
+            # cadence warmup: the faster side has not produced the slower
+            # side's first covered tick yet -- the unpartnered delta must
+            # be empty or the streams can never align
+            if len(lead[0]) or len(lead[1]):
+                self.abort("cadence misalignment at cover start")
+            return
+        ds, dt_ = self.src_seq[i], self.tgt_seq[j]
+        crc_s, crc_t = _crc_pair(ds), _crc_pair(dt_)
+        if crc_s != crc_t or not (np.array_equal(ds[0], dt_[0])
+                                  and np.array_equal(ds[1], dt_[1])):
+            self.abort("event delta mismatch between source and target")
+            return
+        self.crc = zlib.crc32(crc_s.to_bytes(4, "little"), self.crc)
+        self.verified += 1
+        if self.verified >= self.need:
+            with _T.span("aoi.migrate.swap"):
+                self._swap()
+
+    # -- terminal transitions ---------------------------------------------
+
+    def _finish(self) -> None:
+        self.done = True
+        if getattr(self.h, "_migration", None) is self:
+            del self.h._migration
+        if self in self.engine._migrations:
+            self.engine._migrations.remove(self)
+
+    def abort(self, reason: str) -> None:
+        """Roll back to the source bucket: drop the replayed target slot.
+        The source never stopped serving, so nothing is lost."""
+        if self.done:
+            return
+        from ..utils import gwlog
+
+        self._finish()
+        self.engine.release_space(self.t)
+        self.engine.migration_stats["migration_rollbacks"] += 1
+        gwlog.logger("gw.aoi").warning(
+            "live migration rolled back after %d verified flushes: %s",
+            self.verified, reason)
+
+    def _swap(self) -> None:
+        """Atomic ownership swap at the end of a verified flush.
+
+        Undelivered events are reconciled by cadence lag L = lag_t - lag_s
+        (ticks staged through flush k; the caller consumes events every
+        tick, so the source's pending is exactly this flush's delta):
+
+          L == 0: the source's pending becomes the target slot's pending
+                  (the target's own copies were drained into the cover
+                  buffer -- they were already delivered from the source).
+          L == 1: nothing is owed now -- the source's pending re-delivers
+                  from the target's in-flight tick, bit-exact, one tick
+                  later (the space adopts the pipelined cadence).
+          L == -1: the source's pending tick AND the target's newest delta
+                  deliver together -- the space catches up to the
+                  unpipelined cadence in one tick.
+
+        The source slot's release bumps its epoch, so a still-in-flight
+        source tick can neither publish nor XOR (no duplicates); dropping
+        an exclusive source bucket frees its device state.
+        """
+        h, nh, eng = self.h, self.t, self.engine
+        src_bucket, src_slot = h.bucket, h.slot
+        L = self.lag_t - self.lag_s
+        sp = src_bucket._events.pop(src_slot, None)
+        owed = None
+        if L == 0:
+            owed = sp
+        elif L < 0:
+            s_e, s_l = sp if sp is not None else (_EMPTY, _EMPTY)
+            t_e, t_l = self.tgt_seq[-1]
+            owed = (np.concatenate([s_e, t_e]), np.concatenate([s_l, t_l]))
+        if owed is not None and (len(owed[0]) or len(owed[1])):
+            nh.bucket._events[nh.slot] = owed
+        # the Space's handle object never changes: re-point it in place
+        h.bucket, h.slot, h.backend = nh.bucket, nh.slot, nh.backend
+        h.capacity = nh.capacity
+        h.requested = nh.requested or h.requested
+        nh.released = True  # shell handle; h owns the slot now
+        self._finish()
+        src_bucket.release_slot(src_slot)
+        if getattr(src_bucket, "exclusive", False):
+            for k, b in list(eng._buckets.items()):
+                if b is src_bucket:
+                    del eng._buckets[k]
+        eng.migration_stats["migrations"] += 1
+        eng.migration_stats["migration_ms"] += (
+            time.perf_counter() - self.t0) * 1e3
+
+
+@dataclass
+class LoadSample:
+    """One bucket's load since the controller's previous step."""
+
+    key: tuple
+    tier: str
+    entities: int       # occupied slots
+    flush_ms: float     # bucket flush seconds per tick, in ms
+    h2d_bytes: float    # staged wire bytes per tick
+
+
+class PlacementController:
+    """Scores bucket placement from telemetry counters and executes live
+    migrations (Runtime knob ``aoi_placement="static|auto"``).
+
+    ``static`` never moves anything on its own; :meth:`migrate` stays
+    available as the operator/bench entry point.  ``auto`` runs
+    :meth:`step` once per tick (Runtime wires it after the AOI phase):
+    when a host-tier bucket's per-tick flush time exceeds
+    ``threshold_ms``, its busiest space is re-homed onto the device tier;
+    a device bucket idling far below the threshold (entities > 0,
+    flush_ms * 8 < threshold_ms) demotes one space back to the native
+    host calculator.  One migration at a time, ``cooldown_ticks`` between
+    decisions, so a noisy boundary cannot flap."""
+
+    def __init__(self, engine, mode: str = "static",
+                 threshold_ms: float = 5.0, cooldown_ticks: int = 64):
+        if mode not in ("static", "auto"):
+            raise ValueError(
+                f"aoi_placement must be 'static' or 'auto', got {mode!r}")
+        self.engine = engine
+        self.mode = mode
+        self.threshold_ms = threshold_ms
+        self.cooldown_ticks = cooldown_ticks
+        self._cooldown = 0
+        self._tick = 0
+        self._base: dict[tuple, tuple] = {}
+
+    # -- the migration entry point ---------------------------------------
+
+    def migrate(self, h, tier: str) -> _Migration:
+        """Start a live migration of one space to ``tier`` (``cpu`` |
+        ``cpp`` | ``tpu`` | ``mesh`` | ``rowshard``): snapshot + replay
+        now, double-cover over the next flush(es), swap on verified
+        parity.  Returns the in-flight :class:`_Migration`."""
+        eng = self.engine
+        if h.released:
+            raise MigrationError("cannot migrate a released handle")
+        if getattr(h, "_migration", None) is not None:
+            raise MigrationError("handle is already migrating")
+        with _T.span("aoi.migrate"):
+            with _T.span("aoi.migrate.snapshot"):
+                snap = h.bucket.export_snapshot(h.slot)
+            with _T.span("aoi.migrate.replay"):
+                nh = eng._create_handle(h.capacity, tier)
+                nh.bucket.import_snapshot(nh.slot, snap)
+            mig = _Migration(eng, h, nh)
+            h._migration = mig
+            eng._migrations.append(mig)
+        return mig
+
+    # -- telemetry-driven scoring ----------------------------------------
+
+    def load_samples(self) -> list[LoadSample]:
+        """Per-bucket load since the previous call (deterministic order)."""
+        eng = self.engine
+        out = []
+        for key in sorted(eng._buckets):
+            b = eng._buckets[key]
+            perf = sum(getattr(b, "perf", {}).values())
+            h2d = getattr(b, "stats", {}).get("h2d_bytes", 0)
+            base_p, base_h, base_t = self._base.get(
+                key, (0.0, 0, self._tick - 1))
+            dt = max(1, self._tick - base_t)
+            out.append(LoadSample(
+                key=key, tier=eng._tier_of(b),
+                entities=b.n_slots - len(b._free),
+                flush_ms=(perf - base_p) * 1e3 / dt,
+                h2d_bytes=(h2d - base_h) / dt))
+            self._base[key] = (perf, h2d, self._tick)
+        return out
+
+    def _first_handle(self, bucket):
+        live = [h for h in self.engine._handles
+                if h.bucket is bucket and not h.released
+                and getattr(h, "_migration", None) is None]
+        live.sort(key=lambda h: h.slot)
+        return live[0] if live else None
+
+    def decide(self) -> tuple | None:
+        """(handle, target_tier) for the single most pressing move, or
+        None.  Promotion (host -> device) outranks demotion."""
+        eng = self.engine
+        samples = self.load_samples()
+        device_tier = "mesh" if eng.mesh is not None else "tpu"
+        promote = [s for s in samples
+                   if s.tier in ("cpu", "cpp") and s.entities
+                   and s.flush_ms > self.threshold_ms]
+        if promote:
+            worst = max(promote, key=lambda s: s.flush_ms)
+            h = self._first_handle(eng._buckets[worst.key])
+            if h is not None:
+                return h, device_tier
+        demote = [s for s in samples
+                  if s.tier in ("tpu", "mesh") and s.entities
+                  and s.flush_ms * 8 < self.threshold_ms]
+        if demote:
+            idlest = min(demote, key=lambda s: s.flush_ms)
+            h = self._first_handle(eng._buckets[idlest.key])
+            if h is not None:
+                return h, "cpp"
+        return None
+
+    def step(self) -> None:
+        """One controller tick (Runtime calls this after the AOI phase).
+        The double-cover itself is driven by engine.flush; this only makes
+        new placement decisions, and only in ``auto`` mode."""
+        self._tick += 1
+        if self.mode != "auto":
+            return
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return
+        if self.engine._migrations:
+            return  # one live migration at a time
+        plan = self.decide()
+        if plan is not None:
+            h, tier = plan
+            try:
+                self.migrate(h, tier)
+            except MigrationError:
+                pass  # raced with a release; score again next window
+            self._cooldown = self.cooldown_ticks

@@ -28,6 +28,7 @@ from ..utils.crontab import Crontab
 from .aoi import AOIEngine
 from .entity import SYNC_NEIGHBORS, SYNC_OWN, Entity
 from .manager import EntityManager
+from .placement import PlacementController
 from .post import PostQueue
 from .timers import TimerQueue
 
@@ -50,6 +51,9 @@ class Runtime:
         aoi_rowshard_min_capacity: int = 65536,
         aoi_flush_sched: bool = True,
         aoi_emit: str = "auto",
+        aoi_placement: str = "static",
+        aoi_migration_threshold_ms: float = 5.0,
+        aoi_migration_cooldown: int = 64,
         fault_plan: "faults.FaultPlan | str | None" = None,
         telemetry_on: bool = False,
     ):
@@ -75,6 +79,14 @@ class Runtime:
                              tpu_min_capacity=aoi_tpu_min_capacity,
                              rowshard_min_capacity=aoi_rowshard_min_capacity,
                              flush_sched=aoi_flush_sched, emit=aoi_emit)
+        # telemetry-driven placement (engine/placement.py): "static" keeps
+        # spaces where capacity routing put them (migrate() stays available
+        # as the operator entry point); "auto" re-homes hot/idle spaces
+        # between tiers live, one at a time, from per-bucket load scores
+        self.placement = PlacementController(
+            self.aoi, mode=aoi_placement,
+            threshold_ms=aoi_migration_threshold_ms,
+            cooldown_ticks=aoi_migration_cooldown)
         self.entities = EntityManager(self)
         self.tick_count = 0
         # entities with pending sync flags / attr deltas / quiet countdowns;
@@ -112,6 +124,10 @@ class Runtime:
             self._sync_phase()
         with _trace.span("tick.post"):
             self.post.tick(self.on_error)
+        # placement decisions AFTER the tick's phases: scores reflect the
+        # flush that just ran, and a migration started here snapshots
+        # between ticks (no partially-staged state)
+        self.placement.step()
         _TICK_SECONDS.observe(_trace.lap("tick", _t0))
 
     def _aoi_phase(self):

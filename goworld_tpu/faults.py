@@ -41,6 +41,13 @@ seam                      fires in
                           to the host decode path and republishes the
                           same tick bit-exactly (never reaches the
                           device-fault recovery)
+``aoi.device``            device health probe at bucket dispatch; kind
+                          ``reset`` = the chip is LOST (raises
+                          :class:`DeviceLost`): the bucket recovers the
+                          in-flight tick host-side, marks itself
+                          evacuating, and the engine rebuilds its spaces
+                          onto surviving devices (docs/robustness.md
+                          live migration & failover)
 ``conn.send``             typed packet send (proto/connection.py)
 ``conn.flush``            framed batch write (netutil/conn.py flush)
 ``conn.recv``             blocking packet read (netutil/conn.py recv)
@@ -64,7 +71,9 @@ Entry grammar: ``seam:kind@AT[xCOUNT][:ARG]`` -- fire ``kind`` at the
 default 1), with optional float ``ARG`` (stall seconds / partial
 fraction).  ``AT`` may be ``auto``: derived deterministically from the
 plan seed and the seam name, so a seeded plan scatters faults without
-hand-picking ticks.
+hand-picking ticks.  A malformed entry raises ``ValueError`` naming the
+offending token and this grammar (a typo'd ``GW_FAULT_PLAN`` must fail
+loudly at import, not with a bare int() traceback).
 """
 
 from __future__ import annotations
@@ -87,6 +96,8 @@ SEAMS = {
                  "dispatch errors surfacing at the blocking fetch)",
     "aoi.emit": "native event fan-out during harvest publish (demotes to "
                 "host decode, same-tick bit-exact fallback)",
+    "aoi.device": "device health probe at bucket dispatch (reset = chip "
+                  "lost; the bucket evacuates to surviving devices)",
     "conn.send": "typed packet send",
     "conn.flush": "framed batch write",
     "conn.recv": "blocking packet read",
@@ -116,6 +127,18 @@ class KernelFailure(InjectedFault):
     def __init__(self, seam: str, occurrence: int):
         super().__init__(
             f"INTERNAL: injected kernel failure "
+            f"(seam={seam}, occurrence={occurrence})")
+
+
+class DeviceLost(InjectedFault):
+    """Injected permanent device loss (the ``aoi.device`` seam's ``reset``
+    kind).  Unlike :class:`DeviceOOM` -- a transient the bucket recovers
+    from in place -- this one means the chip is GONE: recovery must land
+    on a different device (bucket evacuation, docs/robustness.md)."""
+
+    def __init__(self, seam: str, occurrence: int):
+        super().__init__(
+            f"FAILED_PRECONDITION: injected device loss "
             f"(seam={seam}, occurrence={occurrence})")
 
 
@@ -190,6 +213,10 @@ class FaultPlan:
         if spec.kind == "fail":
             raise KernelFailure(seam, n)
         if spec.kind == "reset":
+            if seam == "aoi.device":
+                # device seams have no connection to reset: reset = the
+                # chip itself is lost (permanent; the bucket must evacuate)
+                raise DeviceLost(seam, n)
             raise ConnectionResetError(
                 f"injected connection reset (seam={seam}, occurrence={n})")
         if spec.kind == "stall":
@@ -221,31 +248,48 @@ class FaultPlan:
                     "specs": [vars(s).copy() for s in self.specs]}
 
 
+_GRAMMAR = ("seam:kind@AT[xCOUNT][:ARG] with AT a 1-based integer or "
+            "'auto', COUNT a positive integer, ARG a float "
+            "(e.g. 'aoi.h2d:oom@3' or 'conn.flush:stall@2x3:0.01')")
+
+
 def parse(text: str) -> FaultPlan:
-    """Parse a ``GW_FAULT_PLAN`` string (grammar in the module docstring)."""
+    """Parse a ``GW_FAULT_PLAN`` string (grammar in the module docstring).
+    Malformed entries raise ``ValueError`` naming the offending token AND
+    the accepted grammar -- a typo'd env var must not surface as a bare
+    ``int()`` traceback with no hint which entry broke."""
     seed = 0
     entries = []
     for part in filter(None, (p.strip() for p in text.split(";"))):
         if part.startswith("seed="):
-            seed = int(part[5:])
+            try:
+                seed = int(part[5:])
+            except ValueError:
+                raise ValueError(
+                    f"bad fault-plan seed {part!r}: want seed=<int>") from None
         else:
             entries.append(part)
     plan = FaultPlan(seed)
     for part in entries:
-        seam, _, rest = part.partition(":")
-        kind, _, where = rest.partition("@")
-        if not where:
-            raise ValueError(f"bad fault spec {part!r} (want seam:kind@at)")
-        arg = None
-        if ":" in where:
-            where, _, argtext = where.partition(":")
-            arg = float(argtext)
-        count = 1
-        if "x" in where:
-            where, _, counttext = where.partition("x")
-            count = int(counttext)
-        at = "auto" if where == "auto" else int(where)
-        plan.add(seam, kind, at, count, arg)
+        try:
+            seam, _, rest = part.partition(":")
+            kind, _, where = rest.partition("@")
+            if not seam or not kind or not where:
+                raise ValueError("missing seam, kind, or @AT")
+            arg = None
+            if ":" in where:
+                where, _, argtext = where.partition(":")
+                arg = float(argtext)
+            count = 1
+            if "x" in where:
+                where, _, counttext = where.partition("x")
+                count = int(counttext)
+            at = "auto" if where == "auto" else int(where)
+            plan.add(seam, kind, at, count, arg)
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault spec {part!r} ({e}); accepted grammar: "
+                f"{_GRAMMAR}") from None
     return plan
 
 

@@ -48,7 +48,14 @@ JAX_PLATFORMS=cpu python scripts/flush_sched_smoke.py || fail=1
 echo "== emit smoke =="
 JAX_PLATFORMS=cpu python scripts/emit_smoke.py || fail=1
 
-# 8. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 8. live-migration + chip-loss failover smoke (CPU backend: one forced
+#    migration and one kill-a-chip evacuation, CRC event parity vs the
+#    uninterrupted oracle, snapshot->replay->cover->swap span order --
+#    docs/robustness.md "Live migration & failover")
+echo "== migration smoke =="
+JAX_PLATFORMS=cpu python scripts/migration_smoke.py || fail=1
+
+# 9. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -38,3 +38,11 @@ if os.environ.get("GW_TPU_TESTS") != "1":
                 jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: exhaustive sweeps excluded from the tier-1 `-m 'not slow'` "
+        "run (each fresh mesh/rowshard engine re-JITs its kernels, ~12s "
+        "per combination on the CPU backend)")

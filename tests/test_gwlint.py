@@ -555,6 +555,61 @@ def test_fault_seam_coverage_sees_root_scripts(tmp_path):
     assert findings == [], [f.render() for f in findings]
 
 
+BUCKET_TIERS = """\
+    class _GoodBucket:
+        def _recover(self, e):
+            pass
+
+        def export_snapshot(self, slot):
+            pass
+
+        def import_snapshot(self, slot, snap):
+            pass
+
+        def evacuate(self):
+            pass
+
+
+    class _BadBucket:
+        def _recover(self, e):
+            pass
+
+        def export_snapshot(self, slot):
+            pass
+
+
+    class _NoRecovery:  # host tier: no _recover, hooks not required
+        def flush(self):
+            pass
+
+
+    from .. import faults
+
+    def flush():
+        faults.check("aoi.kernel")
+"""
+
+
+def test_fault_seam_coverage_requires_evacuation_hooks(tmp_path):
+    """A bucket tier with _recover but without export_snapshot /
+    import_snapshot / evacuate strands its spaces on chip loss: the
+    aoi.device failover path cannot re-home them."""
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py":
+            'SEAMS = {"aoi.kernel": "kernel launch"}\n',
+        "goworld_tpu/engine/aoi_fixture.py": BUCKET_TIERS,
+        "tests/test_f.py": "assert 'aoi.kernel'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    msgs = [f.message for f in findings]
+    assert len(msgs) == 1, msgs
+    assert "_BadBucket" in msgs[0]
+    assert "import_snapshot" in msgs[0] and "evacuate" in msgs[0]
+    assert "export_snapshot" not in msgs[0].split("lacks")[1].split(":")[0]
+    assert findings[0].line == _ln(BUCKET_TIERS, "class _BadBucket")
+
+
 # -- telemetry ---------------------------------------------------------------
 
 TELEM_USER = """\

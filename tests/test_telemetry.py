@@ -55,12 +55,18 @@ CANONICAL_NAMES = (
     "aoi.flush", "aoi.emit", "aoi.h2d", "aoi.stage", "aoi.kernel",
     "aoi.fetch", "aoi.diff", "aoi.decode", "aoi.host_tick", "aoi.buckets",
     "aoi.calc_level", "aoi.emit_path",
+    # live migration / chip-loss failover (engine/placement.py): start
+    # spans, per-flush cover/swap + evacuation spans, totals
+    "aoi.migrate", "aoi.migrate.snapshot", "aoi.migrate.replay",
+    "aoi.migrate.cover", "aoi.migrate.swap", "aoi.evacuate",
+    "aoi.migrations", "aoi.evacuations", "aoi.migration_rollbacks",
+    "aoi.migration_ms",
     # opmon op names (components + net + storage)
     "conn.flush", "gate.client_pkt", "game.outbox", "disp.route",
     "storage.op",
     # dispatchercluster link samples
-    "disp.connected", "disp.attempts", "disp.backoff_s", "disp.pending",
-    "disp.replayed", "disp.dropped",
+    "disp.connected", "disp.attempts", "disp.backoff_s",
+    "disp.next_retry_in", "disp.pending", "disp.replayed", "disp.dropped",
     # fault-injection samples
     "faults.active", "faults.occurrences", "faults.fired",
     # opmon bridge samples
