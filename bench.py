@@ -1618,6 +1618,100 @@ def bench_engine_migrate(cfg, ticks=32, migrate_at=12, cap=1024):
     }
 
 
+def _clustered_walk(cap, n, ticks, world, seed=23):
+    """Deterministic clustered-crowd scenario (the realistic MMO skew:
+    raid boss / town portal): n entities spread over the world teleport
+    into ONE radius-sized cluster mid-walk -- ~n^2/2 interest pairs flip
+    in a single tick -- mill there, then disperse (the mass leave).
+    Returns per-tick (x, z) float32 frames."""
+    rng = np.random.default_rng(seed)
+    x0 = rng.uniform(0.0, world, n).astype(np.float32)
+    z0 = rng.uniform(0.0, world, n).astype(np.float32)
+    tx = (world / 2 + rng.uniform(-40.0, 40.0, n))
+    tz = (world / 2 + rng.uniform(-40.0, 40.0, n))
+    frames = []
+    for t in range(ticks):
+        # spread (t<2) -> storm + milling (2..ticks-2) -> dispersal
+        f = 1.0 if 2 <= t < ticks - 1 else 0.0
+        jx = rng.uniform(-2.0, 2.0, n)
+        jz = rng.uniform(-2.0, 2.0, n)
+        frames.append((
+            np.clip(x0 * (1 - f) + tx * f + jx, 0, world).astype(np.float32),
+            np.clip(z0 * (1 - f) + tz * f + jz, 0, world).astype(np.float32),
+        ))
+    return frames
+
+
+def _clustered_run(frames, cap, n, backend, paged):
+    """Drive one clustered-crowd walk through AOIEngine on the given
+    tier; crc32-fold the delivered streams (the parity oracle)."""
+    from goworld_tpu import faults
+    from goworld_tpu.engine.aoi import AOIEngine
+
+    faults.clear()
+    eng = AOIEngine(backend, paged=paged)
+    h = eng.create_space(cap)
+    r = np.full(n, 100.0, np.float32)
+    act = np.ones(n, bool)
+    crc, n_events, walls = 0, 0, []
+    for x, z in frames:
+        t0 = time.perf_counter()
+        eng.submit(h, x, z, r, act)
+        eng.flush()
+        e, lv = eng.take_events(h)
+        walls.append(time.perf_counter() - t0)
+        e = np.ascontiguousarray(e, np.int32)
+        lv = np.ascontiguousarray(lv, np.int32)
+        crc = zlib.crc32(lv.tobytes(), zlib.crc32(e.tobytes(), crc))
+        n_events += len(e) + len(lv)
+    return crc, n_events, walls, dict(getattr(h.bucket, "stats", {}))
+
+
+def bench_engine_clustered(cfg, cap=2048, n=1800, ticks=8):
+    """Clustered-crowd skew A/B (ROADMAP #2, docs/perf.md paged storage):
+    the SAME mass-enter storm through the single-chip bucket capped
+    (fixed triples cap -- the storm tick overflows it and is flagged in
+    ``decode_overflow``, the BENCH_r05 failure class) and paged (the
+    on-device page allocator absorbs the skew: ``decode_overflow`` and
+    ``overflow_ticks`` MUST be 0; bins past the warming pool spill to
+    host counted in ``page_spills`` and re-arm it).  Both streams must
+    be crc-identical to each other and to the CPU oracle."""
+    frames = _clustered_walk(cap, n, ticks, cfg.world)
+    cpu_crc, cpu_n, _w, _s = _clustered_run(frames, cap, n, "cpu", False)
+    cap_crc, cap_n, cap_walls, cap_st = _clustered_run(
+        frames, cap, n, "tpu", False)
+    pg_crc, pg_n, pg_walls, pg_st = _clustered_run(
+        frames, cap, n, "tpu", True)
+    return {
+        "metric": "engine_clustered_crowd",
+        "config": "clustered_crowd",
+        "kind": "paged vs capped skew A/B",
+        "value": round(n * len(pg_walls) / sum(pg_walls)),
+        "unit": "moves/s",
+        "rate_kind": "e2e",
+        "detail": f"1 space x {n} entities converge into one r=100 "
+                  f"cluster at tick 2 of {ticks} and disperse at "
+                  f"{ticks - 1}; same walk capped vs paged vs CPU oracle",
+        "n_entities": n,
+        "ticks": ticks,
+        # the headline robustness claim: the paged layout retires the
+        # overflow class the capped baseline still flags
+        "overflow_ticks": pg_st["decode_overflow"],
+        "decode_overflow": pg_st["decode_overflow"],
+        "events_per_tick_is_lower_bound": False,
+        "page_spills": pg_st["page_spills"],
+        "page_occupancy": round(pg_st["page_occupancy"], 4),
+        "capped_overflow_ticks": cap_st["decode_overflow"],
+        "events_per_tick": round((pg_n / 2) / ticks, 1),
+        "parity_ok": pg_crc == cap_crc == cpu_crc
+        and pg_n == cap_n == cpu_n,
+        "parity_checksum": f"{pg_crc:08x}",
+        "ms_per_tick": round(sum(pg_walls) / len(pg_walls) * 1e3, 2),
+        "capped_ms_per_tick": round(
+            sum(cap_walls) / len(cap_walls) * 1e3, 2),
+    }
+
+
 def bench_cpu(cfg, xs, zs):
     """CPU baseline: the native C++ sweep calculator when buildable (the
     fair equivalent of the reference's compiled go-aoi XZList), else the
@@ -1839,6 +1933,10 @@ def main():
                 # (no dropped tick, crc parity, migration_ms)
                 emit(bench_engine_failover(cfg))
                 emit(bench_engine_migrate(cfg))
+                # clustered-crowd skew A/B (docs/perf.md paged storage):
+                # platform-agnostic like the two above -- the paged layout
+                # must retire the overflow class the capped one flags
+                emit(bench_engine_clustered(cfg))
                 import jax
 
                 if jax.default_backend() != "tpu":

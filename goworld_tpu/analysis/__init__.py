@@ -1,6 +1,6 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Nine checkers, each
+Run as ``python -m goworld_tpu.analysis <paths>``.  Ten checkers, each
 an AST pass over the tree (stdlib-only -- no jax import needed):
 
 ===================  =====================================================
@@ -19,6 +19,8 @@ telemetry            every metric/span name is documented + tested; the
                      telemetry package never syncs the device
 flush-phase          no host-sync call reachable from a bucket dispatch()
                      body (the split-phase scheduler's overlap contract)
+bounded-caps         cap-shaped device buffers carry a counted overflow
+                     fallback (no silent fixed-cap truncation)
 ===================  =====================================================
 
 See docs/static-analysis.md for the suppression story.
@@ -26,8 +28,9 @@ See docs/static-analysis.md for the suppression story.
 
 from __future__ import annotations
 
-from . import (coverage, determinism, dtypes, fault_seams, flush_phase,
-               h2d_staging, host_sync, telemetry_rule, wire_protocol)
+from . import (bounded_caps, coverage, determinism, dtypes, fault_seams,
+               flush_phase, h2d_staging, host_sync, telemetry_rule,
+               wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
 CHECKERS = [
@@ -40,6 +43,7 @@ CHECKERS = [
     fault_seams.check,
     telemetry_rule.check,
     flush_phase.check,
+    bounded_caps.check,
 ]
 
 __all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]

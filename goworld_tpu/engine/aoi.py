@@ -38,6 +38,7 @@ import numpy as np
 
 from .. import faults, telemetry
 from ..ops import aoi_emit as AE
+from ..ops import aoi_pages as PG
 from ..ops import aoi_predicate as P
 from ..ops.aoi_oracle import CPUAOIOracle
 from ..telemetry import trace as _T
@@ -49,6 +50,7 @@ from ..ops import events as EV
 
 _fused_impl = None  # built lazily: jax must not load in cpu-only processes
 _fused_tri_impl = None
+_fused_paged_impl = None
 _clear_impl = None
 
 
@@ -370,6 +372,66 @@ def _fused_bucket_step_tri(prev_all, *args):
     return _fused_tri_impl(prev_all, *args)
 
 
+def _fused_bucket_step_paged(prev_all, *args):
+    """Paged-mode bucket flush (docs/perf.md paged storage, ROADMAP #2):
+    same gather / fused kernel / scatter prologue as
+    :func:`_fused_bucket_step`, but the diff compacts into page-granular
+    word entries through the on-device allocator (ops/aoi_pages): each
+    allocation bin's nonzero change words land on pages drawn from the
+    shared free list, so a dense hotspot borrows pages sparse bins never
+    needed and NO global per-tick cap exists -- bins the pool cannot
+    serve are reported in ``spill_bins`` for the counted spill-to-host
+    fallback instead of truncating anything.  Harvest fetches the used
+    page prefix, the page table, and one scalar vector.  The raw
+    ``new``/``chg`` grids still ride donated scratch for the spill and
+    poisoned-scalar recoveries.
+
+    ``args`` = (new_buf, chg_buf, pg_buf, pc_buf, pn_buf, free, slot_idx,
+    x_all, z_all, r_all, act_all, sub_all, page_words, bin_words,
+    max_spill, platform).
+    """
+    global _fused_paged_impl
+    if _fused_paged_impl is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.aoi_dense import aoi_step_chg
+
+        @functools.partial(
+            jax.jit,
+            static_argnames=("page_words", "bin_words", "max_spill",
+                             "platform"),
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+        def impl(prev_all, new_buf, chg_buf, pg_buf, pc_buf, pn_buf,
+                 free, slot_idx, x_all, z_all, r_all, act_all, sub_all,
+                 page_words, bin_words, max_spill, platform=None):
+            prev_rows = prev_all[slot_idx]
+            x = x_all[slot_idx]
+            z = z_all[slot_idx]
+            r = r_all[slot_idx]
+            act = act_all[slot_idx]
+            sub = sub_all[slot_idx]
+            new, chg = aoi_step_chg(x, z, r, act, prev_rows,
+                                    platform=platform)
+            prev_all = prev_all.at[slot_idx].set(new)
+            chg = jnp.where(sub[:, None, None], chg, jnp.uint32(0))
+            (pg, pc, pn, page_tab, free_next, spill_bins,
+             scalars) = PG.allocate_pages(chg, new, free, page_words,
+                                          bin_words, max_spill)
+            new_buf = new_buf.at[:].set(new)
+            chg_buf = chg_buf.at[:].set(chg)
+            pg_buf = pg_buf.at[:].set(pg)
+            pc_buf = pc_buf.at[:].set(pc)
+            pn_buf = pn_buf.at[:].set(pn)
+            return (prev_all, new_buf, chg_buf, pg_buf, pc_buf, pn_buf,
+                    page_tab, free_next, spill_bins, scalars)
+
+        _fused_paged_impl = impl
+    return _fused_paged_impl(prev_all, *args)
+
+
 class _CapDecay:
     """Windowed decay of adaptive extraction caps, shared by the TPU
     buckets (single-chip and mesh).  Growth on overflow is the owner's
@@ -456,6 +518,158 @@ class _TriCapDecay:
         return None
 
 
+class _PageDecay:
+    """Windowed decay of the paged pool size (the exact _TriCapDecay
+    story for ``n_pages``: growth on spill is the owner's job -- bounded
+    by ops/aoi_pages.pool_ceiling, past which the pool can never spill --
+    and this proposes post-storm shrinks on a doubling window, reporting
+    ``steady`` once the static compile key is final)."""
+
+    def __init__(self, floor: int):
+        self.floor = floor
+        self.peak = 0
+        self.flushes = 0
+        self.refit_at = 8
+        self.steady = False
+
+    def reset_after_growth(self) -> None:
+        self.peak = 0
+        self.flushes = 0
+        self.refit_at = 8
+        self.steady = False
+
+    def observe(self, n_used: int, cur: int) -> int | None:
+        """Track one flush's used-page peak; at the window boundary
+        return the shrunk pool size to adopt, or None."""
+        self.peak = max(self.peak, n_used)
+        self.flushes += 1
+        if self.flushes < self.refit_at:
+            return None
+        fit = max(self.floor,
+                  1 << (max(self.peak * 3 // 2, 1) - 1).bit_length())
+        self.peak = 0
+        self.flushes = 0
+        self.refit_at = min(self.refit_at * 2, 128)
+        if fit < cur:
+            self.steady = False  # one more clean window confirms
+            return fit
+        self.steady = True
+        return None
+
+
+def _paged_absorb_chip(bk, chg_dev, new_dev, W: int):  # gwlint: allow[host-sync] -- counted overflow absorber: fetches used pages + spilled bins instead of a chip's full diff grid
+    """Absorb one chip's decode overflow through the paged pool
+    (docs/perf.md, paged storage): instead of growing the stream caps (a
+    recompile) and fetching the chip's FULL diff grid, compact the kept
+    change/new grids into pages ON DEVICE (ops/aoi_pages) and fetch only
+    the used prefix -- plus any spilled bins host-side, as a counted
+    graceful degradation.  Shares the bucket's persistent free list /
+    pool-decay state (``_page_free``/``_n_pages``/``_pages``) across
+    chips and ticks; the ``aoi.pages`` seam crosses once per absorbed
+    chip (oom/fail/partial = whole-grid spill + pool re-arm; poison =
+    page-table corruption caught by validation -> whole-grid spill +
+    free-list reinit -- the multi-chip pool is transient per-harvest, so
+    reinit IS the rebuild).
+
+    Returns ``(chg_vals, ent_vals, gidx)`` with chip-LOCAL flat word
+    indices (the caller offsets by its chip base), bit-exact with the
+    raw-grid recovery it replaces.
+    """
+    from ..utils import gwlog
+    import jax.numpy as jnp
+
+    nw = int(np.prod(chg_dev.shape))
+    bw = PG.bin_words_for(W)
+    if bk._pages is None:
+        bk._pages = _PageDecay(floor=PG.pool_floor(nw))
+    want = max(bk._n_pages, bk._pages.floor)
+    if bk._page_free is None or int(bk._page_free.shape[0]) != want:
+        bk._n_pages = want
+        bk._page_free = jnp.arange(want, dtype=jnp.int32)
+    n_pages = bk._n_pages
+
+    def _whole_grid():  # gwlint: allow[host-sync] -- counted whole-grid spill drains on purpose
+        # counted spill: the raw-grid fallback the capped path used
+        bk.stats["page_spills"] += 1
+        chg_h = np.asarray(chg_dev).reshape(-1)
+        new_h = np.asarray(new_dev).reshape(-1)
+        gidx = np.nonzero(chg_h)[0]
+        chg_vals = chg_h[gidx]
+        return chg_vals, chg_vals & new_h[gidx], np.asarray(gidx, np.int64)
+
+    try:
+        spec = faults.check("aoi.pages")
+    except Exception as e:  # noqa: BLE001 -- seam-injected device faults
+        if not _device_fault(e):
+            raise
+        gwlog.logger("gw.aoi").warning(
+            "AOI page pool unusable for this chip (%s); spilling its "
+            "whole grid to host and re-arming the pool", e)
+        bk._page_free = None
+        bk._pages.reset_after_growth()
+        return _whole_grid()
+    if spec is not None and spec.kind == "partial":
+        gwlog.logger("gw.aoi").warning(
+            "AOI page allocation reported partial for this chip; "
+            "spilling its whole grid to host and re-arming the pool")
+        bk._page_free = None
+        bk._pages.reset_after_growth()
+        return _whole_grid()
+    _tp = _T.t()
+    (pg, pc, pn, tab, free_next, sb, scal) = PG.paged_extract(
+        chg_dev.reshape(-1), new_dev.reshape(-1), bk._page_free,
+        page_words=PG.PAGE_WORDS, bin_words=bw, max_spill=PG.MAX_SPILL)
+    bk._page_free = free_next
+    scal_h = np.asarray(scal)
+    n_used, n_spill = int(scal_h[0]), int(scal_h[1])
+    n_bins = -(-nw // bw)
+    tab_h = np.asarray(tab)
+    if spec is not None and spec.kind == "poison":
+        # seam-injected allocator corruption: trash the fetched table so
+        # validation must catch it (docs/robustness.md, aoi.pages)
+        tab_h = np.full_like(tab_h, np.iinfo(np.int32).min)
+    bad_scal = not (0 <= n_used <= n_pages and 0 <= n_spill <= n_bins)
+    if bad_scal or not PG.validate_page_table(
+            tab_h, 0 if bad_scal else n_used, n_pages):
+        bk.stats["poisoned"] += 1
+        gwlog.logger("gw.aoi").warning(
+            "AOI page table failed validation during overflow absorb "
+            "(n_used=%d, n_pages=%d); spilling the chip's whole grid and "
+            "reinitialising the free list", n_used, n_pages)
+        bk._page_free = None
+        bk._pages.reset_after_growth()
+        out = _whole_grid()
+        _T.lap("aoi.pages", _tp)
+        return out
+    pg_h = np.asarray(pg[:max(n_used, 1)])[:n_used]
+    pc_h = np.asarray(pc[:max(n_used, 1)])[:n_used]
+    pn_h = np.asarray(pn[:max(n_used, 1)])[:n_used]
+    gidx, chg_vals, new_vals = PG.decode_pages(pg_h, pc_h, pn_h)
+    if n_spill:
+        # hotter than the pool: counted spill for the offending bins +
+        # pool growth so the NEXT storm tick absorbs fully page-side
+        bk.stats["page_spills"] += n_spill
+        sgi, sc, sn = PG.spill_stream(
+            chg_dev.reshape(-1), new_dev.reshape(-1), np.asarray(sb),
+            bw, nw)
+        gidx = np.concatenate([np.asarray(gidx, np.int64), sgi])
+        chg_vals = np.concatenate([chg_vals, sc])
+        new_vals = np.concatenate([new_vals, sn])
+        grown = min(PG.pool_ceiling(nw, bw), max(n_pages * 2, 64))
+        if grown > n_pages:
+            bk._n_pages = grown
+            bk._page_free = None
+        bk._pages.reset_after_growth()
+    else:
+        shrink = bk._pages.observe(n_used, n_pages)
+        if shrink is not None:
+            bk._n_pages = shrink
+            bk._page_free = None
+    bk.stats["page_occupancy"] = n_used / max(n_pages, 1)
+    _T.lap("aoi.pages", _tp)
+    return chg_vals, chg_vals & new_vals, np.asarray(gidx, np.int64)
+
+
 @dataclass(eq=False)  # identity hash: handles live in a WeakSet registry
 class SpaceAOIHandle:
     backend: str        # resolved (cpu | cpp | tpu)
@@ -483,8 +697,17 @@ class AOIEngine:
                  pipeline: bool = False, delta_staging: bool = True,
                  tpu_min_capacity: int = 4096,
                  rowshard_min_capacity: int = 65536,
-                 flush_sched: bool = True, emit: str = "auto"):
+                 flush_sched: bool = True, emit: str = "auto",
+                 paged: bool = False):
         self.default_backend = default_backend
+        # paged ragged event storage (docs/perf.md paged storage): the
+        # device buckets compact their change stream into fixed-size pages
+        # drawn from a shared on-device free list instead of a global
+        # per-tick cap, retiring the decode_overflow failure class for
+        # skewed (clustered-crowd) distributions.  Off by default while
+        # the capped layouts remain the tuned production path; bench.py's
+        # clustered_crowd config A/Bs the two.
+        self.paged = bool(paged)
         # event emit fan-out path for the device buckets (docs/perf.md):
         # "auto" = fastest available (native when libgwemit builds, else
         # vector), "host" = the original per-word host decode kept as the
@@ -639,7 +862,7 @@ class AOIEngine:
                     bucket = _RowShardTPUBucket(
                         capacity, self.mesh, pipeline=self.pipeline,
                         delta_staging=self.delta_staging,
-                        emit=self._resolve_emit())
+                        emit=self._resolve_emit(), paged=self.paged)
                     self._rowshard_serial += 1
                     key = (f"tpu-rowshard-{self._rowshard_serial}", capacity)
                 elif self.mesh is not None:
@@ -648,11 +871,12 @@ class AOIEngine:
                     bucket = _MeshTPUBucket(
                         capacity, self.mesh, pipeline=self.pipeline,
                         delta_staging=self.delta_staging,
-                        emit=self._resolve_emit())
+                        emit=self._resolve_emit(), paged=self.paged)
                 else:
                     bucket = _TPUBucket(capacity, pipeline=self.pipeline,
                                         delta_staging=self.delta_staging,
-                                        emit=self._resolve_emit())
+                                        emit=self._resolve_emit(),
+                                        paged=self.paged)
             else:
                 raise ValueError(f"unknown AOI backend {backend!r}")
             self._buckets[key] = bucket
@@ -681,7 +905,8 @@ class AOIEngine:
 
             bucket = _RowShardTPUBucket(
                 capacity, self.mesh, pipeline=self.pipeline,
-                delta_staging=self.delta_staging, emit=self._resolve_emit())
+                delta_staging=self.delta_staging, emit=self._resolve_emit(),
+                paged=self.paged)
             self._rowshard_serial += 1
             self._buckets[(f"tpu-rowshard-{self._rowshard_serial}",
                            capacity)] = bucket
@@ -696,7 +921,7 @@ class AOIEngine:
                 bucket = _MeshTPUBucket(
                     capacity, self.mesh, pipeline=self.pipeline,
                     delta_staging=self.delta_staging,
-                    emit=self._resolve_emit())
+                    emit=self._resolve_emit(), paged=self.paged)
                 self._buckets[key] = bucket
         elif tier == "tpu":
             key = (("tpu-single", capacity) if self.mesh is not None
@@ -705,7 +930,8 @@ class AOIEngine:
             if bucket is None:
                 bucket = _TPUBucket(capacity, pipeline=self.pipeline,
                                     delta_staging=self.delta_staging,
-                                    emit=self._resolve_emit())
+                                    emit=self._resolve_emit(),
+                                    paged=self.paged)
                 self._buckets[key] = bucket
         else:
             raise ValueError(f"unknown placement tier {tier!r}")
@@ -859,6 +1085,7 @@ class AOIEngine:
         perf: dict[str, float] = {}
         calc_level = 0
         emit_path = 0
+        page_occ = 0.0
         for b in (self._buckets[k] for k in sorted(self._buckets)):
             for k, v in getattr(b, "stats", {}).items():
                 if k == "calc_level":
@@ -867,6 +1094,11 @@ class AOIEngine:
                     # like calc_level: the WORST bucket -- one demoted emit
                     # path should page even among healthy neighbors
                     emit_path = max(emit_path, v)
+                elif k == "page_occupancy":
+                    # gauge, not a counter: the FULLEST pool -- the bucket
+                    # closest to spilling is the one capacity planning
+                    # must see
+                    page_occ = max(page_occ, v)
                 else:
                     stats[k] = stats.get(k, 0) + v
             for k, v in getattr(b, "perf", {}).items():
@@ -878,7 +1110,10 @@ class AOIEngine:
                       "(0=pallas 1=dense 2=host oracle)"),
                Sample("aoi.emit_path", "gauge", emit_path, lbl,
                       "worst emit-path fallback level "
-                      "(0=native 1=vector 2=host decode)")]
+                      "(0=native 1=vector 2=host decode)"),
+               Sample("aoi.page_occupancy", "gauge", page_occ, lbl,
+                      "fullest page pool at last harvest "
+                      "(used/total pages; paged buckets only)")]
         for k in sorted(stats):
             out.append(Sample("aoi." + k, "counter", stats[k], lbl,
                               "summed per-bucket AOI stat"))
@@ -1178,10 +1413,23 @@ class _TPUBucket(_Bucket):
     """
 
     def __init__(self, capacity: int, pipeline: bool = False,
-                 delta_staging: bool = True, emit: str = "vector"):
+                 delta_staging: bool = True, emit: str = "vector",
+                 paged: bool = False):
         super().__init__(capacity)
         self.pipeline = pipeline
         self.delta_staging = delta_staging
+        # paged ragged storage (docs/perf.md paged storage): the change
+        # stream compacts into fixed-size pages from an on-device free
+        # list (ops/aoi_pages) instead of the capped triples/chunk
+        # buffers -- no global per-tick cap, so decode_overflow cannot
+        # fire; bins the pool cannot serve spill to host (counted in
+        # page_spills, republished same-tick bit-exact) and re-arm the
+        # pool through _PageDecay
+        self.paged = bool(paged)
+        self._n_pages = 0           # pool size; sized at first dispatch
+        self._page_free = None      # device free list [n_pages] int32
+        self._pages: _PageDecay | None = None
+        self._pred_pages = 64       # optimistic page prefetch (pipeline)
         # emit fan-out path (docs/perf.md): "native"/"vector" run the
         # device-resident triples decode (_fused_bucket_step_tri) and fan
         # out through ops/aoi_emit; "host" keeps the classic encoded-stream
@@ -1292,10 +1540,15 @@ class _TPUBucket(_Bucket):
         # overflowed its cap and fell back to a counted full recovery;
         # emit_path = the fan-out level actually in use (0=native 1=vector
         # 2=host decode), surfaced like calc_level as a max gauge.
+        # paged-path additions: page_spills = bins (or whole ticks) the
+        # page pool could not serve, re-read from the kept change grid and
+        # republished same-tick (counted, never silent); page_occupancy =
+        # used/total pages at the last harvest (gauge, worst bucket wins)
         self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
                       "poisoned": 0, "calc_level": 0,
                       "decode_overflow": 0,
+                      "page_spills": 0, "page_occupancy": 0.0,
                       "emit_path": AE.EMIT_LEVEL[emit]}
         # phase-attribution counters (seconds, cumulative): stage = host
         # pack + H2D enqueue + dispatch, fetch = synchronous D2H waits,
@@ -1308,8 +1561,10 @@ class _TPUBucket(_Bucket):
 
     @property
     def _steady(self) -> bool:
-        """No cap recompile pending (see _CapDecay/_TriCapDecay; benchmarks
-        read this)."""
+        """No cap recompile pending (see _CapDecay/_TriCapDecay/_PageDecay;
+        benchmarks read this)."""
+        if self.paged:
+            return self._pages is not None and self._pages.steady
         if self._emit != "host":
             return self._tri.steady
         return self._caps.steady
@@ -1581,8 +1836,27 @@ class _TPUBucket(_Bucket):
         self._cur_slots = slots  # recovery needs them once _staged is gone
 
         slot_idx = jnp.asarray(slots, jnp.int32)
-        tri_mode = self._emit != "host"
-        if tri_mode:
+        tri_mode = self._emit != "host" and not self.paged
+        if self.paged:
+            # paged path (docs/perf.md paged storage): the change stream
+            # compacts into pages from the device-resident free list; the
+            # scratch key uses mc=-2 as the paged namespace.  The pool is
+            # (re)sized here: first dispatch seeds the floor, spills grow
+            # it (bounded by pool_ceiling), _PageDecay shrinks it back --
+            # a size change just reinitializes the free list.
+            nw = s_n * c * self.W
+            bw = PG.bin_words_for(self.W)
+            if self._pages is None:
+                self._pages = _PageDecay(floor=PG.pool_floor(nw))
+            # the decay's floor (not a recomputed one) sizes the first
+            # pool, so tests can preset a tiny _PageDecay to force spills
+            want = max(self._n_pages, self._pages.floor)
+            if self._page_free is None or want != self._n_pages \
+                    or self._page_free.shape[0] != want:
+                self._n_pages = want
+                self._page_free = jnp.arange(want, dtype=jnp.int32)
+            key = (s_n, -2, self._n_pages)
+        elif tri_mode:
             # triples path (docs/perf.md emit paths): the decode happens ON
             # DEVICE; harvest fetches [count, 3] triples + one scalar.  The
             # scratch key uses mc=-1 as the tri namespace (classic mc >= 512)
@@ -1600,7 +1874,16 @@ class _TPUBucket(_Bucket):
             # inflight record double-buffer naturally.
             while len(self._scratch) >= 4:
                 self._scratch.pop(next(iter(self._scratch)))
-            if tri_mode:
+            if self.paged:
+                scratch = (
+                    jnp.zeros((s_n, c, self.W), jnp.uint32),
+                    jnp.zeros((s_n, c, self.W), jnp.uint32),
+                    jnp.full((self._n_pages, PG.PAGE_WORDS), -1,
+                             jnp.int32),
+                    jnp.zeros((self._n_pages, PG.PAGE_WORDS), jnp.uint32),
+                    jnp.zeros((self._n_pages, PG.PAGE_WORDS), jnp.uint32),
+                )
+            elif tri_mode:
                 scratch = (
                     jnp.zeros((s_n, c, self.W), jnp.uint32),
                     jnp.zeros((s_n, c, self.W), jnp.uint32),
@@ -1624,6 +1907,50 @@ class _TPUBucket(_Bucket):
         self._fault_phase = "kernel"
         faults.check("aoi.kernel")
         all_unsub = not sub.any()
+        if self.paged:
+            out = _fused_bucket_step_paged(
+                self.prev, *scratch, self._page_free, slot_idx,
+                self._dev["x"], self._dev["z"], self._dev["r"],
+                self._dev["act"], self._dev["sub"],
+                PG.PAGE_WORDS, bw, PG.MAX_SPILL,
+                "cpu" if self._calc_level >= 1 else None
+            )
+            (self.prev, new, chg, pg, pc, pn, page_tab, self._page_free,
+             spill_bins, scalars) = out
+            _T.lap("aoi.kernel", _tk)
+            if not all_unsub:
+                scalars.copy_to_host_async()
+                page_tab.copy_to_host_async()
+                spill_bins.copy_to_host_async()
+            rec = {
+                "mode": "paged",
+                "slots": slots, "s_n": s_n, "key": key,
+                "n_pages": self._n_pages, "bin_words": bw,
+                "epochs": [self._slot_epoch.get(s, 0) for s in slots],
+                "scratch": (new, chg, pg, pc, pn),
+                "page_tab": page_tab,
+                "spill_bins": spill_bins,
+                "scalars": scalars,
+                "all_unsub": all_unsub,
+                "prefetch": None,
+            }
+            if self.pipeline and not all_unsub:
+                # optimistic page prefetch: the used prefix rides the wire
+                # while the host runs the next tick; harvest refetches on
+                # a misfit
+                ndp = min(self._n_pages, self._pred_pages)
+                sl_pg = (pg[:ndp], pc[:ndp], pn[:ndp])
+                for a in sl_pg:
+                    a.copy_to_host_async()
+                rec["prefetch"] = (ndp, sl_pg)
+            prev_rec, self._inflight = self._inflight, rec
+            self.perf["stage_s"] += time.perf_counter() - t_stage0
+            if self.pipeline:
+                if prev_rec is not None:
+                    self._sched = ("rec", prev_rec)
+            else:
+                self._sched = ("inflight",)
+            return
         if tri_mode:
             out = _fused_bucket_step_tri(
                 self.prev, *scratch, slot_idx, self._dev["x"],
@@ -1920,6 +2247,7 @@ class _TPUBucket(_Bucket):
         self._dev.clear()
         self._dev_stale = {"xz", "ra", "sub"}
         self._scratch.clear()
+        self._page_free = None  # paged free list reinits at next dispatch
         self._need_rebuild = self._calc_level < 2
         if rec_slots:
             self._host_tick(rec_slots, publish_now=True)
@@ -1978,6 +2306,9 @@ class _TPUBucket(_Bucket):
             self._publish(rec["slots"], rec["epochs"], chg_vals, ent_vals,
                           gidx, s_n)
             self._apply_deferred_mirror_ops()
+            return
+        if rec.get("mode") == "paged":
+            self._harvest_paged(rec)
             return
         if rec.get("mode") == "tri":
             self._harvest_tri(rec)
@@ -2168,6 +2499,177 @@ class _TPUBucket(_Bucket):
         gw = (srows * c + obs % c) * self.W + j % self.W
         bit = (j // self.W).astype(np.uint32)
         np.bitwise_xor.at(self._mirror.reshape(-1), gw, np.uint32(1) << bit)
+
+    def _grow_pool(self, nw: int, bw: int, full: bool = False) -> None:
+        """Spill re-arm (the growth half of the _PageDecay contract,
+        mirroring the tri/chunk cap growth): double the pool, bounded by
+        pool_ceiling -- a pool at the ceiling can NEVER spill (full word
+        coverage plus per-bin rounding) -- and reinitialize the free list
+        at the next dispatch.  ``full`` jumps straight to the ceiling: a
+        WHOLE-TICK spill (> MAX_SPILL bins) is an unambiguous undersize
+        signal, and doubling through a sustained storm would spill every
+        tick of it; _PageDecay shrinks the pool back afterwards."""
+        ceil_p = PG.pool_ceiling(nw, bw)
+        grown = ceil_p if full else min(ceil_p, max(self._n_pages * 2, 64))
+        if grown > self._n_pages:
+            self._n_pages = grown
+            self._page_free = None
+        if self._pages is not None:
+            self._pages.reset_after_growth()
+
+    def _harvest_paged(self, rec) -> None:  # gwlint: allow[host-sync] -- paged-path drain point: fetches the used page prefix once per flush
+        """Harvest one paged tick: fetch the used page prefix + page table
+        + scalars, validate the allocator's page table, merge any spilled
+        bins' words re-read from the kept change grid, XOR the mirror, and
+        publish (docs/perf.md paged storage; docs/robustness.md spill
+        chain).  Degradation ladder: spilled bins re-read host-side
+        (counted in page_spills, same-tick bit-exact); pool exhaustion
+        injected through the ``aoi.pages`` seam (oom/fail/partial) forces
+        a counted whole-tick spill from the raw grids and re-arms the
+        pool; a corrupt page table (``aoi.pages`` poison, or real
+        allocator rot) re-raises as RESOURCE_EXHAUSTED to ride
+        :meth:`_recover_harvest`'s rebuild-from-host-shadows."""
+        slots, s_n = rec["slots"], rec["s_n"]
+        n_pages, bw = rec["n_pages"], rec["bin_words"]
+        c = self.capacity
+        (new, chg, pg, pc, pn) = rec["scratch"]
+        nw = s_n * c * self.W
+        faults.check("aoi.fetch")  # stallable: a delayed host sync
+        t_f0 = time.perf_counter()
+        _tf = _T.t()
+        poisoned = False
+        n_used = n_spill = 0
+        page_spec = page_fault = None
+        if not rec.get("all_unsub"):
+            raw = faults.filter("aoi.scalars", np.asarray(rec["scalars"]))
+            n_used, n_spill, nz_fit, nz_total = (int(v) for v in raw)
+            n_bins = -(-nw // bw)
+            if not (0 <= n_used <= n_pages and 0 <= n_spill <= n_bins
+                    and 0 <= nz_fit <= nw and 0 <= nz_total <= nw):
+                from ..utils import gwlog
+
+                self.stats["poisoned"] += 1
+                gwlog.logger("gw.aoi").warning(
+                    "AOI page scalars failed validation (used=%d spill=%d "
+                    "fit=%d total=%d); recovering the tick from the raw "
+                    "diff grids", n_used, n_spill, nz_fit, nz_total)
+                poisoned = True
+                n_used = n_spill = 0
+            # the aoi.pages seam (docs/robustness.md): oom/fail = pool
+            # exhaustion, partial = untrustworthy allocation -- all three
+            # force the counted whole-tick spill below; poison corrupts
+            # the fetched page table (validated further down)
+            try:
+                page_spec = faults.check("aoi.pages")
+            except Exception as pe:
+                if not _device_fault(pe):
+                    raise
+                page_fault = pe
+            if page_spec is not None and page_spec.kind == "partial":
+                page_fault = page_spec
+        shrink = (None if poisoned or n_spill or page_fault is not None
+                  else self._pages.observe(n_used, n_pages))
+        if shrink is not None and shrink < self._n_pages:
+            self._n_pages = shrink
+            self._page_free = None  # reinit at the shrunk size
+        if poisoned or page_fault is not None or n_spill > PG.MAX_SPILL:
+            # whole-tick spill: the page stream is untrustworthy (poisoned
+            # scalars), the allocator faulted (aoi.pages oom/fail/partial),
+            # or more bins spilled than the reporting vector holds --
+            # recover this tick from the raw diff grids riding the same
+            # record (bit-exact; np.nonzero's ascending flat order matches
+            # the device extraction's), then re-arm the pool
+            if not poisoned:
+                from ..utils import gwlog
+
+                self.stats["page_spills"] += 1
+                gwlog.logger("gw.aoi").warning(
+                    "AOI page pool unusable this tick (%s); spilling the "
+                    "whole tick to host and re-arming the pool",
+                    page_fault if page_fault is not None
+                    else f"{n_spill} bins spilled > {PG.MAX_SPILL}")
+                # organic mass-spill = the pool is way undersized: jump to
+                # the ceiling.  A fault-caused spill says nothing about
+                # size, so it only doubles.
+                self._grow_pool(nw, bw, full=page_fault is None)
+            chg_h = np.asarray(chg).reshape(-1)
+            new_h = np.asarray(new).reshape(-1)
+            gidx = np.nonzero(chg_h)[0]
+            chg_vals = chg_h[gidx]
+            ent_vals = chg_vals & new_h[gidx]
+            self.perf["fetch_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.fetch", _tf)
+            t_f0 = time.perf_counter()
+            _td = _T.t()
+            self._mirror_xor_stream(slots, rec["epochs"], gidx, chg_vals)
+            self._scratch.setdefault(rec["key"], rec["scratch"])
+            self._publish(slots, rec["epochs"], chg_vals, ent_vals, gidx,
+                          s_n)
+            self.perf["decode_s"] += time.perf_counter() - t_f0
+            _T.lap("aoi.diff", _td)
+            return
+        if n_used == 0:
+            pg_h = np.empty((0, PG.PAGE_WORDS), np.int32)
+            pc_h = pn_h = np.empty((0, PG.PAGE_WORDS), np.uint32)
+        else:
+            pf = rec["prefetch"]
+            if pf is not None and pf[0] >= n_used:
+                pg_h, pc_h, pn_h = (np.asarray(a)[:n_used] for a in pf[1])
+            else:
+                ndp = min(n_pages, -(-max(n_used, 1) // 16) * 16)
+                slices = (pg[:ndp], pc[:ndp], pn[:ndp])
+                for a in slices:
+                    a.copy_to_host_async()
+                pg_h, pc_h, pn_h = (np.asarray(a)[:n_used] for a in slices)
+        self.perf["fetch_s"] += time.perf_counter() - t_f0
+        _T.lap("aoi.fetch", _tf)
+        # refit the next dispatch's optimistic page prefetch to this tick
+        self._pred_pages = max(
+            64, min(self._n_pages, -(-n_used * 5 // 4 // 16) * 16))
+        t_f0 = time.perf_counter()
+        _tp = _T.t()
+        if n_used:
+            # page-table integrity: the table is the allocator's word of
+            # which logical pages back this tick; a duplicate, out-of-range
+            # or truncated id means the free list itself is corrupt -- not
+            # a per-tick cap problem -- so the ONLY safe recovery is the
+            # full device-state rebuild from the host shadows
+            tab_h = np.asarray(rec["page_tab"])
+            if page_spec is not None and page_spec.kind == "poison":
+                tab_h = np.full_like(tab_h, np.iinfo(np.int32).min)
+            if not PG.validate_page_table(tab_h, n_used, n_pages):
+                self.stats["poisoned"] += 1
+                self._page_free = None  # rebuilt (arange) at next dispatch
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: aoi.pages page table failed "
+                    f"validation (n_used={n_used}, n_pages={n_pages}) -- "
+                    "allocator free list corrupt")
+        gidx, chg_vals, new_vals = PG.decode_pages(pg_h, pc_h, pn_h)
+        gidx = gidx.astype(np.int64)
+        if n_spill:
+            # counted graceful degradation: the pool served every bin it
+            # could; the spilled bins' words are re-read from the kept
+            # change grid (small per-bin D2H slices), merged unsorted --
+            # the mirror XOR is order-independent over unique words and
+            # both emit paths sort before expansion -- and the pool grows
+            # for the next tick (decay shrinks it back post-storm)
+            self.stats["page_spills"] += n_spill
+            sb = np.asarray(rec["spill_bins"])
+            sg, sc, sn2 = PG.spill_stream(chg.reshape(-1), new.reshape(-1),
+                                          sb, bw, nw)
+            gidx = np.concatenate([gidx, sg])
+            chg_vals = np.concatenate([chg_vals, sc])
+            new_vals = np.concatenate([new_vals, sn2])
+            self._grow_pool(nw, bw)
+        ent_vals = chg_vals & new_vals
+        self.stats["page_occupancy"] = (n_used / n_pages) if n_pages else 0.0
+        _T.lap("aoi.pages", _tp)
+        _td = _T.t()
+        self._mirror_xor_stream(slots, rec["epochs"], gidx, chg_vals)
+        self._scratch.setdefault(rec["key"], rec["scratch"])
+        self._publish(slots, rec["epochs"], chg_vals, ent_vals, gidx, s_n)
+        self.perf["decode_s"] += time.perf_counter() - t_f0
+        _T.lap("aoi.diff", _td)
 
     def _harvest_tri(self, rec) -> None:  # gwlint: allow[host-sync] -- triples-path drain point: fetches the compact triple buffer once per flush
         """Harvest one tri-mode tick: fetch the compact (observer, observed,
@@ -2377,7 +2879,8 @@ class _TPUBucket(_Bucket):
                 faults.check("aoi.delta")
                 rows, cols = np.nonzero(diff)
                 pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
-                                    new_z[rows, cols])
+                                    new_z[rows, cols],
+                                    page_granular=self.paged)
                 self._dev["x"], self._dev["z"] = AS.apply_packet(
                     self._dev["x"], self._dev["z"], *pkt)
                 self.stats["h2d_bytes"] += AS.packet_nbytes(*pkt)

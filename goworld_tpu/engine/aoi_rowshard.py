@@ -53,7 +53,7 @@ from ..ops import events as EV
 from ..ops import aoi_emit as AE
 from .aoi import (_Bucket, _CapDecay, _build_snapshot, _device_fault,
                   _emit_expand, _kernelish_fault, _packed_predicate,
-                  _unpack_positions)
+                  _paged_absorb_chip, _unpack_positions)
 from ..parallel.compat import shard_map
 
 _LANES = 128
@@ -65,9 +65,20 @@ class _RowShardTPUBucket(_Bucket):
     exclusive = True  # engine: one bucket per space, dropped at release
 
     def __init__(self, capacity: int, mesh, pipeline: bool = False,
-                 delta_staging: bool = True, emit: str = "vector"):
+                 delta_staging: bool = True, emit: str = "vector",
+                 paged: bool = False):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
+
+        # paged overflow absorber (docs/perf.md, paged storage): a chip
+        # whose encoded stream overflows its caps is recovered through
+        # the device-side page allocator (used pages + spilled bins D2H)
+        # instead of growing the caps (a recompile) and fetching its full
+        # diff grid; counted in page_spills, never decode_overflow
+        self.paged = bool(paged)
+        self._n_pages = 0
+        self._page_free = None
+        self._pages = None  # _PageDecay, lazily sized at first absorb
 
         # emit path for the harvested word streams (docs/perf.md emit
         # paths; see _MeshTPUBucket -- "vector" and "host" coincide here)
@@ -134,6 +145,7 @@ class _RowShardTPUBucket(_Bucket):
         self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
                       "poisoned": 0, "calc_level": 0, "decode_overflow": 0,
+                      "page_spills": 0, "page_occupancy": 0.0,
                       "emit_path": AE.EMIT_LEVEL[emit]}
         self._pred = (512, 64, 256)
         self.full_roundtrips = 0
@@ -235,7 +247,8 @@ class _RowShardTPUBucket(_Bucket):
                 faults.check("aoi.delta")
                 cols = np.nonzero(diff)[0]
                 _, cols, xv, zv = AS.pad_packet(cols, cols, self._hx[cols],
-                                                self._hz[cols])
+                                                self._hz[cols],
+                                                page_granular=self.paged)
                 self._dxs, self._dzs, self._dxr, self._dzr = \
                     self._delta_fn(len(cols))(
                         self._dxs, self._dzs, self._dxr, self._dzr,
@@ -641,23 +654,40 @@ class _RowShardTPUBucket(_Bucket):
             _tf = _T.t()
             if nd > mc or mcc > kcap:
                 # incomplete stream: recover from this chip's raw diff grid
-                self._max_chunks = max(self._max_chunks, 2 * nd)
-                self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
-                self.stats["decode_overflow"] += 1
-                grew = True
                 lo = d * cl
-                chg_h = np.asarray(chg[lo:lo + cl]).reshape(-1)
-                new_h = np.asarray(self.prev[lo:lo + cl]).reshape(-1)
-                gidx = np.nonzero(chg_h)[0]
-                chg_vals = chg_h[gidx]
-                ent_vals = chg_vals & new_h[gidx]
-                self.perf["fetch_s"] += time.perf_counter() - t0
-                _T.lap("aoi.fetch", _tf)
+                if self.paged:
+                    # paged absorber: compact the kept grids into pages
+                    # on device and fetch only the used prefix -- no cap
+                    # growth, no recompile, decode_overflow stays 0
+                    chg_vals, ent_vals, gidx = _paged_absorb_chip(
+                        self, chg[lo:lo + cl], self.prev[lo:lo + cl],
+                        self.W)
+                    self.perf["fetch_s"] += time.perf_counter() - t0
+                    _T.lap("aoi.fetch", _tf)
+                else:
+                    self._max_chunks = max(self._max_chunks, 2 * nd)
+                    self._kcap = min(max(self._kcap, 2 * mcc), _LANES)
+                    self.stats["decode_overflow"] += 1
+                    grew = True
+                    chg_h = np.asarray(chg[lo:lo + cl]).reshape(-1)
+                    new_h = np.asarray(self.prev[lo:lo + cl]).reshape(-1)
+                    gidx = np.nonzero(chg_h)[0]
+                    chg_vals = chg_h[gidx]
+                    ent_vals = chg_vals & new_h[gidx]
+                    self.perf["fetch_s"] += time.perf_counter() - t0
+                    _T.lap("aoi.fetch", _tf)
             elif n_esc > mg or exc_n > mx:
-                self._max_gaps = max(mg, 2 * n_esc)
-                self._max_exc = max(mx, 2 * exc_n)
-                self.stats["decode_overflow"] += 1
-                grew = True
+                # encode overflow: rebuild from the kept chunk grids.  In
+                # paged mode this is a counted spill (the chunk grids ARE
+                # the compact recovery source, bounded by mc rows), with
+                # no cap growth so the compile key never churns.
+                if self.paged:
+                    self.stats["page_spills"] += 1
+                else:
+                    self._max_gaps = max(mg, 2 * n_esc)
+                    self._max_exc = max(mx, 2 * exc_n)
+                    self.stats["decode_overflow"] += 1
+                    grew = True
                 lo = d * mc
                 vh = np.asarray(g_vals[lo:lo + mc])
                 nh = np.asarray(g_nv[lo:lo + mc])
@@ -815,6 +845,7 @@ class _RowShardTPUBucket(_Bucket):
         self._xz_stale = True
         self._h2d_cache.clear()
         self._scratch.clear()
+        self._page_free = None  # device-resident free list died with it
         if staged:
             self._host_tick(old_prev)
         else:

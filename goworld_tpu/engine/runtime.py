@@ -51,6 +51,7 @@ class Runtime:
         aoi_rowshard_min_capacity: int = 65536,
         aoi_flush_sched: bool = True,
         aoi_emit: str = "auto",
+        aoi_paged: bool = False,
         aoi_placement: str = "static",
         aoi_migration_threshold_ms: float = 5.0,
         aoi_migration_cooldown: int = 64,
@@ -78,7 +79,8 @@ class Runtime:
                              delta_staging=aoi_delta_staging,
                              tpu_min_capacity=aoi_tpu_min_capacity,
                              rowshard_min_capacity=aoi_rowshard_min_capacity,
-                             flush_sched=aoi_flush_sched, emit=aoi_emit)
+                             flush_sched=aoi_flush_sched, emit=aoi_emit,
+                             paged=aoi_paged)
         # telemetry-driven placement (engine/placement.py): "static" keeps
         # spaces where capacity routing put them (migrate() stays available
         # as the operator entry point); "auto" re-homes hot/idle spaces

@@ -48,6 +48,17 @@ seam                      fires in
                           evacuating, and the engine rebuilds its spaces
                           onto surviving devices (docs/robustness.md
                           live migration & failover)
+``aoi.pages``             paged-storage allocator at harvest (paged
+                          buckets, docs/perf.md): ``oom``/``fail``/
+                          ``partial`` = pool exhaustion -- the bucket
+                          spills the whole tick to host from the kept
+                          change grid (counted in ``aoi.page_spills``),
+                          republishes it same-tick bit-exactly and
+                          re-arms the pool; ``poison`` = page-table
+                          corruption -- validation catches it and the
+                          bucket rebuilds from the host shadows
+                          (``_recover_harvest``), reinitializing the
+                          free list
 ``conn.send``             typed packet send (proto/connection.py)
 ``conn.flush``            framed batch write (netutil/conn.py flush)
 ``conn.recv``             blocking packet read (netutil/conn.py recv)
@@ -98,6 +109,9 @@ SEAMS = {
                 "host decode, same-tick bit-exact fallback)",
     "aoi.device": "device health probe at bucket dispatch (reset = chip "
                   "lost; the bucket evacuates to surviving devices)",
+    "aoi.pages": "paged-storage allocator at harvest (oom/fail/partial = "
+                 "counted whole-tick spill + pool re-arm; poison = page-"
+                 "table corruption caught by validation -> shadow rebuild)",
     "conn.send": "typed packet send",
     "conn.flush": "framed batch write",
     "conn.recv": "blocking packet read",

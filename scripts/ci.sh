@@ -55,7 +55,18 @@ JAX_PLATFORMS=cpu python scripts/emit_smoke.py || fail=1
 echo "== migration smoke =="
 JAX_PLATFORMS=cpu python scripts/migration_smoke.py || fail=1
 
-# 9. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 9. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+#    over every declared seam, bit-exact parity + zero stuck buckets
+#    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
+if [ "${GW_SOAK:-0}" = "1" ]; then
+    echo "== faults soak =="
+    JAX_PLATFORMS=cpu python scripts/faults_soak.py \
+        "${GW_SOAK_ROUNDS:-4}" "${GW_SOAK_SEED:-1000}" || fail=1
+else
+    echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
+fi
+
+# 10. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Randomized fault-plan soak: N seedable plans over every declared seam.
+
+Each round derives a fresh :class:`~goworld_tpu.faults.FaultPlan` from
+``base_seed + round``: every AOI seam gets one spec with a kind drawn
+from its legal menu at an ``@auto`` occurrence (sha256 of (seed, seam) --
+stable across processes), then a paged TPU-path engine walks a seeded
+random world next to an UNINJECTED CPU oracle.  The contract under test
+is the whole self-healing story at once, seams interacting:
+
+* bit-exact enter/leave parity on every tick, faults and all;
+* zero stuck buckets -- after the plan exhausts, the operator re-arm
+  (``reset_calc_chain``/``reset_emit_path``; demotion is deliberately
+  sticky) plus two clean ticks puts every bucket back at
+  ``calc_level == 0`` with no pending repair and parity intact;
+* the connection seams get the same treatment against a live socket:
+  injected resets on flush/connect must still deliver every payload
+  exactly once, in order, with the outage buffer drained.
+
+Runs on the CPU backend in under a minute with the default 4 rounds.
+Opt-in ci.sh step (GW_SOAK=1); ``faults_soak.py [rounds] [base_seed]``
+for a longer stand-alone soak.  docs/robustness.md describes the seams.
+"""
+
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from goworld_tpu import faults  # noqa: E402
+from goworld_tpu.engine.aoi import AOIEngine  # noqa: E402
+
+# legal kind menu per AOI seam (what its recovery path is built to absorb
+# on the single-chip tier; aoi.device reset = chip loss needs a mesh to
+# evacuate onto, so the soak sticks to its transient kinds)
+AOI_SEAM_KINDS = {
+    "aoi.grow": ["oom", "fail"],
+    "aoi.h2d": ["oom", "fail", "stall"],
+    "aoi.delta": ["oom", "fail"],
+    "aoi.kernel": ["oom", "fail"],
+    "aoi.scalars": ["poison", "stall"],
+    "aoi.fetch": ["oom", "fail", "stall"],
+    "aoi.emit": ["oom", "fail"],
+    "aoi.pages": ["oom", "fail", "partial", "poison"],
+    "aoi.device": ["oom", "fail"],
+}
+
+
+def build_plan(seed: int) -> faults.FaultPlan:
+    rng = np.random.default_rng(seed)
+    plan = faults.FaultPlan(seed=seed)
+    for seam, kinds in sorted(AOI_SEAM_KINDS.items()):
+        kind = kinds[int(rng.integers(len(kinds)))]
+        arg = 0.001 if kind == "stall" else None
+        plan.add(seam, kind, at="auto", arg=arg)
+    return plan
+
+
+def soak_aoi(seed: int, cap=256, n=200, ticks=10) -> dict:
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 600, cap).astype(np.float32)
+    z = rng.uniform(0, 600, cap).astype(np.float32)
+    r = rng.uniform(60, 120, cap).astype(np.float32)
+    act = np.zeros(cap, bool)
+    act[:n] = True
+
+    oracle = AOIEngine(default_backend="cpu")
+    oh = oracle.create_space(cap)
+    plan = build_plan(seed)
+    faults.install(plan)
+    try:
+        eng = AOIEngine(default_backend="tpu", paged=True)
+        h = eng.create_space(cap)
+        # ticks under fire, then the operator re-arm (demotion is sticky
+        # by design) and two clean ticks proving the device path is back
+        for t in range(ticks + 2):
+            if t == ticks:
+                faults.clear()
+                h.bucket.reset_calc_chain()
+                h.bucket.reset_emit_path()
+            x = np.clip(x + rng.uniform(-20, 20, cap), 0, 600) \
+                .astype(np.float32)
+            z = np.clip(z + rng.uniform(-20, 20, cap), 0, 600) \
+                .astype(np.float32)
+            eng.submit(h, x, z, r, act)
+            oracle.submit(oh, x, z, r, act)
+            eng.flush()
+            oracle.flush()
+            e, l = eng.take_events(h)
+            ce, cl = oracle.take_events(oh)
+            np.testing.assert_array_equal(e, ce,
+                                          err_msg=f"enter t={t} seed={seed}")
+            np.testing.assert_array_equal(l, cl,
+                                          err_msg=f"leave t={t} seed={seed}")
+        st = dict(h.bucket.stats)
+        assert st["calc_level"] == 0, f"stuck bucket seed={seed}: {st}"
+        return {"fired": len(plan.fired), "stats": st}
+    finally:
+        faults.clear()
+
+
+class _Recorder:
+    """A dispatcher stand-in: records every framed payload it receives."""
+
+    def __init__(self):
+        from goworld_tpu.netutil.conn import FrameParser, serve_tcp
+
+        self.payloads: list[bytes] = []
+        self._stop = threading.Event()
+        self._FrameParser = FrameParser
+        self.ls = serve_tcp(("127.0.0.1", 0), self._on_conn,
+                            stop_event=self._stop)
+        self.addr = self.ls.getsockname()
+
+    def _on_conn(self, sock, peer):
+        parser = self._FrameParser()
+        while not self._stop.is_set():
+            try:
+                data = sock.recv(65536)
+            except OSError:
+                return
+            if not data:
+                return
+            for p in parser.feed(data):
+                self.payloads.append(p.payload)
+
+    def close(self):
+        self._stop.set()
+        self.ls.close()
+
+
+def soak_dispatcher(seed: int, n_payloads=12) -> dict:
+    from goworld_tpu.dispatchercluster import DispatcherCluster
+    from goworld_tpu.netutil.packet import Packet
+
+    rng = np.random.default_rng(seed)
+    plan = faults.FaultPlan(seed=seed)
+    plan.add("conn.flush", "reset", at="auto")
+    plan.add("disp.connect", "reset",
+             at=int(rng.integers(1, 3)), count=int(rng.integers(1, 3)))
+    rec = _Recorder()
+    faults.install(plan)
+    c = DispatcherCluster([rec.addr], on_packet=lambda i, p: None,
+                          register=lambda conn: None, tag="soak",
+                          backoff_base=0.05, backoff_cap=0.2).start()
+    try:
+        assert c.wait_connected(5.0), f"never connected seed={seed}"
+        sent = [b"soak-%d-%02d" % (seed, i) for i in range(n_payloads)]
+        for payload in sent:
+            c.post(0, Packet(bytearray(payload)))
+            c.flush_all()
+            time.sleep(0.01)
+        deadline = time.monotonic() + 10.0
+        while len(rec.payloads) < len(sent) and time.monotonic() < deadline:
+            c.flush_all()
+            time.sleep(0.05)
+        assert rec.payloads == sent, \
+            f"delivery broke seed={seed}: {rec.payloads} != {sent}"
+        st = c.status()[0]
+        assert st["pending"] == 0 and st["dropped"] == 0, \
+            f"stuck outage buffer seed={seed}: {st}"
+        return {"fired": len(plan.fired), "replayed": st["replayed"]}
+    finally:
+        faults.clear()
+        c.stop()
+        rec.close()
+
+
+def main(argv):
+    rounds = int(argv[1]) if len(argv) > 1 else 4
+    base_seed = int(argv[2]) if len(argv) > 2 else 1000
+    for i in range(rounds):
+        seed = base_seed + i
+        a = soak_aoi(seed)
+        d = soak_dispatcher(seed)
+        print(f"round {i + 1}/{rounds} seed={seed}: "
+              f"aoi fired={a['fired']} rebuilds={a['stats']['rebuilds']} "
+              f"host_ticks={a['stats']['host_ticks']} "
+              f"page_spills={a['stats']['page_spills']} | "
+              f"disp fired={d['fired']} replayed={d['replayed']} -- "
+              f"bit-exact, no stuck buckets")
+    print(f"faults_soak: OK ({rounds} rounds, all seams, parity held)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

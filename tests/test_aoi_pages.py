@@ -1,0 +1,423 @@
+"""Paged ragged neighbor/event storage (ISSUE 8 / ROADMAP #2).
+
+Covers the page allocator (ops/aoi_pages) against its NumPy oracle, the
+paged single-chip bucket end-to-end (parity vs the CPU oracle ±pipeline
+±emit ±flush_sched), clustered-crowd skew absorption with ZERO
+``decode_overflow`` on all three tiers (single-chip, mesh, row-sharded),
+and the ``aoi.pages`` fault seam: exhaustion (oom) spills the tick to
+host and republishes bit-exactly, page-table poison is caught by
+validation and self-heals (shadow rebuild single-chip, free-list reinit
+on the multi-chip absorbers)."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu import faults
+from goworld_tpu.engine.aoi import AOIEngine, _PageDecay
+from goworld_tpu.ops import aoi_pages as PG
+from test_aoi_parity import random_walk_scenario
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def make_mesh(n=8):
+    from goworld_tpu.parallel import SpaceMesh, multichip_devices
+
+    devs = multichip_devices(n)
+    if len(devs) < n:
+        pytest.skip(f"need {n} devices")
+    return SpaceMesh(devs)
+
+
+# -- allocator unit parity vs the NumPy oracle ---------------------------
+
+
+def _rand_grid(rng, n_words, density):
+    chg = np.where(rng.random(n_words) < density,
+                   rng.integers(1, 1 << 32, n_words, dtype=np.uint64)
+                   .astype(np.uint32), np.uint32(0))
+    new = rng.integers(0, 1 << 32, n_words, dtype=np.uint64) \
+        .astype(np.uint32)
+    return chg, new
+
+
+def test_allocator_oracle_parity():
+    """paged_extract (jitted device pass) is bit-identical to
+    allocate_pages_host on every output, across densities, pool sizes,
+    and rotated free lists."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    for n_words, bw, n_pages, density in [
+        (4096, 512, 16, 0.01),    # sparse: everything fits
+        (4096, 512, 16, 0.5),     # skewed-heavy: spills
+        (4096, 256, 4, 0.9),      # tiny pool: most bins spill
+        (2048, 512, 64, 0.25),    # roomy pool, uneven bins
+        (4100, 512, 16, 0.3),     # non-multiple of bin_words (padding)
+    ]:
+        chg, new = _rand_grid(rng, n_words, density)
+        free = rng.permutation(n_pages).astype(np.int32)
+        dev = PG.paged_extract(jnp.asarray(chg), jnp.asarray(new),
+                               jnp.asarray(free), page_words=PG.PAGE_WORDS,
+                               bin_words=bw, max_spill=PG.MAX_SPILL)
+        host = PG.allocate_pages_host(chg, new, free,
+                                      page_words=PG.PAGE_WORDS,
+                                      bin_words=bw,
+                                      max_spill=PG.MAX_SPILL)
+        for i, (d, h) in enumerate(zip(dev, host)):
+            np.testing.assert_array_equal(
+                np.asarray(d), np.asarray(h),
+                err_msg=f"output {i} nw={n_words} bw={bw} "
+                        f"pages={n_pages} d={density}")
+
+
+def test_allocator_decode_roundtrip_and_ceiling():
+    """Decoding granted pages + re-reading spilled bins reproduces the
+    full nonzero stream; a pool at pool_ceiling can never spill."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n_words, bw = 4096, 512
+    chg, new = _rand_grid(rng, n_words, 0.6)
+    # ceiling pool: zero spill by construction
+    cp = PG.pool_ceiling(n_words, bw)
+    out = PG.paged_extract(jnp.asarray(chg), jnp.asarray(new),
+                           jnp.arange(cp, dtype=jnp.int32), bin_words=bw)
+    scal = np.asarray(out[6])
+    assert scal[1] == 0, "ceiling pool spilled"
+    n_used = int(scal[0])
+    gidx, cvals, nvals = PG.decode_pages(
+        np.asarray(out[0])[:n_used], np.asarray(out[1])[:n_used],
+        np.asarray(out[2])[:n_used])
+    ref = np.nonzero(chg)[0]
+    np.testing.assert_array_equal(np.sort(gidx), ref)
+    order = np.argsort(gidx)
+    np.testing.assert_array_equal(cvals[order], chg[ref])
+    np.testing.assert_array_equal(nvals[order], new[ref])
+    # tiny pool: granted pages + spill_stream together cover the grid
+    out = PG.paged_extract(jnp.asarray(chg), jnp.asarray(new),
+                           jnp.arange(4, dtype=jnp.int32), bin_words=bw)
+    scal = np.asarray(out[6])
+    n_used, n_spill = int(scal[0]), int(scal[1])
+    assert n_spill > 0
+    gidx, cvals, nvals = PG.decode_pages(
+        np.asarray(out[0])[:n_used], np.asarray(out[1])[:n_used],
+        np.asarray(out[2])[:n_used])
+    sg, sc, sn = PG.spill_stream(chg, new, np.asarray(out[5]), bw, n_words)
+    allg = np.concatenate([np.asarray(gidx, np.int64), sg])
+    allc = np.concatenate([cvals, sc])
+    alln = np.concatenate([nvals, sn])
+    order = np.argsort(allg)
+    np.testing.assert_array_equal(allg[order], ref)
+    np.testing.assert_array_equal(allc[order], chg[ref])
+    np.testing.assert_array_equal(alln[order], new[ref])
+
+
+def test_page_table_validation():
+    tab = np.array([3, 0, 2, -1, -1], np.int32)
+    assert PG.validate_page_table(tab, 3, 5)
+    assert not PG.validate_page_table(tab, 4, 5)       # -1 inside prefix
+    assert not PG.validate_page_table(
+        np.array([3, 3, 2, -1, -1], np.int32), 3, 5)   # duplicate
+    assert not PG.validate_page_table(
+        np.array([5, 0, 2, -1, -1], np.int32), 3, 5)   # out of range
+    assert not PG.validate_page_table(
+        np.full(5, np.iinfo(np.int32).min, np.int32), 3, 5)
+
+
+def test_pad_packet_page_granular():
+    from goworld_tpu.ops import aoi_stage as AS
+
+    def mk(k):
+        i = np.arange(k, dtype=np.int32)
+        return i, i, i.astype(np.float32), i.astype(np.float32)
+
+    # mid-size packets round to whole pages (<= one page of waste)...
+    assert len(AS.pad_packet(*mk(130), page_granular=True)[0]) == 192
+    assert len(AS.pad_packet(*mk(130))[0]) == 256  # pow2 default
+    # ...tiny and huge packets take the pow2 ladder either way
+    assert len(AS.pad_packet(*mk(30), page_granular=True)[0]) == 64
+    assert len(AS.pad_packet(*mk(513), page_granular=True)[0]) == 1024
+    # padding repeats the last entry (idempotent under the set scatter)
+    rows, cols, xv, zv = AS.pad_packet(*mk(130), page_granular=True)
+    assert (rows[130:] == 129).all() and (xv[130:] == 129.0).all()
+
+
+# -- end-to-end engine parity --------------------------------------------
+
+
+def run_paged(scenarios, cap, oracle_out, **kw):
+    eng = AOIEngine(default_backend="tpu", paged=True, **kw)
+    hs = [eng.create_space(cap) for _ in scenarios]
+    out = []
+    for t in range(len(scenarios[0])):
+        for h, sc in zip(hs, scenarios):
+            x, z, r, act = sc[t]
+            eng.submit(h, x, z, r, act)
+        eng.flush()
+        out.append([eng.take_events(h) for h in hs])
+    shift = 1 if kw.get("pipeline") else 0
+    if shift:  # trailing flush delivers the last pipelined tick
+        for h, sc in zip(hs, scenarios):
+            eng.submit(h, *sc[-1])
+        eng.flush()
+        out.append([eng.take_events(h) for h in hs])
+    for t in range(len(oracle_out)):
+        for s, ((e, l), (ce, cl)) in enumerate(
+                zip(out[t + shift], oracle_out[t])):
+            np.testing.assert_array_equal(
+                e, ce, err_msg=f"enter t={t} s={s} kw={kw}")
+            np.testing.assert_array_equal(
+                l, cl, err_msg=f"leave t={t} s={s} kw={kw}")
+    return eng, hs
+
+
+def cpu_oracle(scenarios, cap):
+    eng = AOIEngine(default_backend="cpu")
+    hs = [eng.create_space(cap) for _ in scenarios]
+    out = []
+    for t in range(len(scenarios[0])):
+        for h, sc in zip(hs, scenarios):
+            eng.submit(h, *sc[t])
+        eng.flush()
+        out.append([eng.take_events(h) for h in hs])
+    return out
+
+
+def test_paged_single_chip_parity_variants():
+    """Paged single-chip bucket, bit-exact vs the CPU oracle: default,
+    pipelined (one tick late), host emit path, and sequential flush."""
+    cap = 256
+    scenarios = [list(random_walk_scenario(s, cap, 200, 4))
+                 for s in range(2)]
+    oracle = cpu_oracle(scenarios, cap)
+    eng, hs = run_paged(scenarios, cap, oracle)
+    assert all(h.bucket.paged for h in hs)
+    assert hs[0].bucket.stats["decode_overflow"] == 0
+    assert hs[0].bucket.stats["page_occupancy"] > 0
+    run_paged(scenarios, cap, oracle, pipeline=True)
+    run_paged(scenarios, cap, oracle, emit="host")
+    run_paged(scenarios, cap, oracle, flush_sched=False)
+
+
+def test_paged_tiny_pool_spills_and_rearms():
+    """A floor-4 pool spills (counted), republishes bit-exactly the same
+    tick, and grows back through the _PageDecay re-arm."""
+    cap = 256
+    scenarios = [list(random_walk_scenario(7, cap, 220, 4))]
+    oracle = cpu_oracle(scenarios, cap)
+    eng = AOIEngine(default_backend="tpu", paged=True)
+    h = eng.create_space(cap)
+    h.bucket._pages = _PageDecay(floor=4)  # dispatch honours the floor
+    out = []
+    for t in range(len(scenarios[0])):
+        eng.submit(h, *scenarios[0][t])
+        eng.flush()
+        out.append(eng.take_events(h))
+    for t, ((e, l), tick) in enumerate(zip(out, oracle)):
+        np.testing.assert_array_equal(e, tick[0][0], err_msg=f"t={t}")
+        np.testing.assert_array_equal(l, tick[0][1], err_msg=f"t={t}")
+    st = h.bucket.stats
+    assert st["page_spills"] > 0 and st["decode_overflow"] == 0
+    assert h.bucket._n_pages > 4  # the pool re-armed past the tiny floor
+
+
+# -- clustered-crowd skew: zero decode_overflow on all three tiers -------
+
+
+def clustered_frames(cap, n, ticks, world=2000.0, seed=23):
+    """Spread -> one-cluster storm -> dispersal (the bench's skew)."""
+    rng = np.random.default_rng(seed)
+    x0 = rng.uniform(0, world, cap).astype(np.float32)
+    z0 = rng.uniform(0, world, cap).astype(np.float32)
+    tx = world / 2 + rng.uniform(-40, 40, cap)
+    tz = world / 2 + rng.uniform(-40, 40, cap)
+    r = np.full(cap, 100.0, np.float32)
+    act = np.zeros(cap, bool)
+    act[:n] = True
+    frames = []
+    for t in range(ticks):
+        f = 1.0 if 2 <= t < ticks - 1 else 0.0
+        x = np.clip(x0 * (1 - f) + tx * f + rng.uniform(-2, 2, cap),
+                    0, world).astype(np.float32)
+        z = np.clip(z0 * (1 - f) + tz * f + rng.uniform(-2, 2, cap),
+                    0, world).astype(np.float32)
+        frames.append((x, z, r, act))
+    return frames
+
+
+def drive_one(eng, frames, cap):
+    h = eng.create_space(cap)
+    out = []
+    for fr in frames:
+        eng.submit(h, *fr)
+        eng.flush()
+        out.append(eng.take_events(h))
+    return h, out
+
+
+def assert_stream_parity(out, oracle, name):
+    for t, ((e, l), (ce, cl)) in enumerate(zip(out, oracle)):
+        np.testing.assert_array_equal(e, ce, err_msg=f"{name} enter t={t}")
+        np.testing.assert_array_equal(l, cl, err_msg=f"{name} leave t={t}")
+
+
+def test_clustered_skew_single_chip_retires_overflow():
+    """The storm tick overflows the capped triples layout (counted in
+    decode_overflow -- the old failure class); the paged layout absorbs
+    it with decode_overflow == 0, bit-exact either way."""
+    cap, n = 1024, 800
+    frames = clustered_frames(cap, n, 5)
+    _, oracle = drive_one(AOIEngine(default_backend="cpu"), frames, cap)
+    hc, capped = drive_one(AOIEngine(default_backend="tpu"), frames, cap)
+    assert_stream_parity(capped, oracle, "capped")
+    assert hc.bucket.stats["decode_overflow"] > 0  # the baseline flags it
+    hp, paged = drive_one(
+        AOIEngine(default_backend="tpu", paged=True), frames, cap)
+    assert_stream_parity(paged, oracle, "paged")
+    st = hp.bucket.stats
+    assert st["decode_overflow"] == 0
+    assert st["page_spills"] > 0 or st["page_occupancy"] > 0
+
+
+def _forced_overflow_tier(paged, plan=None, rowshard=False, cap=1024,
+                          n=500, pipeline=False):
+    """Mesh / row-shard engine with _max_chunks=1: every real tick takes
+    the overflow branch, so the paged absorber IS the steady path."""
+    if plan is not None:
+        faults.install(plan)
+    kw = {"rowshard_min_capacity": cap} if rowshard else {}
+    eng = AOIEngine(default_backend="tpu", mesh=make_mesh(8), paged=paged,
+                    pipeline=pipeline, **kw)
+    h = eng.create_space(cap)
+    if rowshard:
+        from goworld_tpu.engine.aoi_rowshard import _RowShardTPUBucket
+
+        assert isinstance(h.bucket, _RowShardTPUBucket)
+    h.bucket._max_chunks = 1
+    h.bucket._step_cache.clear()
+    rng = np.random.default_rng(3)
+    x = rng.uniform(0, 600, cap).astype(np.float32)
+    z = rng.uniform(0, 600, cap).astype(np.float32)
+    r = np.full(cap, 80, np.float32)
+    act = np.zeros(cap, bool)
+    act[:n] = True
+    oracle = AOIEngine(default_backend="cpu")
+    oh = oracle.create_space(cap)
+    outs, oouts = [], []
+    for _t in range(4):
+        x = np.clip(x + rng.uniform(-25, 25, cap), 0, 600) \
+            .astype(np.float32)
+        z = np.clip(z + rng.uniform(-25, 25, cap), 0, 600) \
+            .astype(np.float32)
+        eng.submit(h, x, z, r, act)
+        oracle.submit(oh, x, z, r, act)
+        eng.flush(); oracle.flush()
+        outs.append(eng.take_events(h))
+        oouts.append(oracle.take_events(oh))
+    if pipeline and not rowshard:  # trailing flush (rowshard is sync)
+        eng.submit(h, x, z, r, act)
+        eng.flush()
+        outs.append(eng.take_events(h))
+    shift = 1 if (pipeline and not rowshard) else 0
+    for t in range(len(oouts) - shift):
+        np.testing.assert_array_equal(outs[t + shift][0], oouts[t][0],
+                                      err_msg=f"enter t={t}")
+        np.testing.assert_array_equal(outs[t + shift][1], oouts[t][1],
+                                      err_msg=f"leave t={t}")
+    st = dict(h.bucket.stats)
+    grown = h.bucket._max_chunks > 1
+    faults.clear()
+    return st, grown
+
+
+@pytest.mark.parametrize("rowshard", [False, True],
+                         ids=["mesh", "rowshard"])
+def test_paged_absorber_multichip(rowshard):
+    """Forced per-chip overflow on the mesh / row-shard tier: capped
+    grows caps + counts decode_overflow; paged absorbs through the page
+    pool with decode_overflow == 0 and NO cap growth (no recompile)."""
+    st, grown = _forced_overflow_tier(False, rowshard=rowshard)
+    assert st["decode_overflow"] > 0 and grown
+    st, grown = _forced_overflow_tier(True, rowshard=rowshard)
+    assert st["decode_overflow"] == 0 and not grown
+    assert st["page_occupancy"] > 0 or st["page_spills"] > 0
+
+
+@pytest.mark.parametrize("rowshard", [False, True],
+                         ids=["mesh", "rowshard"])
+def test_paged_absorber_faults_multichip(rowshard):
+    """aoi.pages oom and poison on the multi-chip absorbers: counted
+    whole-grid spill / free-list reinit, events stay bit-exact."""
+    plan = faults.FaultPlan()
+    plan.add("aoi.pages", "oom", at=2)
+    st, _ = _forced_overflow_tier(True, plan=plan, rowshard=rowshard)
+    assert st["page_spills"] >= 1 and st["decode_overflow"] == 0
+    plan = faults.FaultPlan()
+    plan.add("aoi.pages", "poison", at=2)
+    st, _ = _forced_overflow_tier(True, plan=plan, rowshard=rowshard)
+    assert st["poisoned"] >= 1 and st["decode_overflow"] == 0
+
+
+@pytest.mark.slow
+def test_paged_absorber_mesh_pipeline():
+    """Pipelined mesh + paged absorber: the pre-dispatch peek harvests
+    the overflowing tick early, the absorber reads a live prev."""
+    st, grown = _forced_overflow_tier(True, pipeline=True)
+    assert st["decode_overflow"] == 0 and not grown
+
+
+# -- the aoi.pages seam, single-chip -------------------------------------
+
+
+def _seamed_walk(plan):
+    cap = 256
+    scenarios = [list(random_walk_scenario(9, cap, 220, 5))]
+    oracle = cpu_oracle(scenarios, cap)
+    faults.install(plan)
+    eng = AOIEngine(default_backend="tpu", paged=True)
+    h = eng.create_space(cap)
+    out = []
+    for t in range(len(scenarios[0])):
+        eng.submit(h, *scenarios[0][t])
+        eng.flush()
+        out.append(eng.take_events(h))
+    faults.clear()
+    for t, ((e, l), tick) in enumerate(zip(out, oracle)):
+        np.testing.assert_array_equal(e, tick[0][0], err_msg=f"t={t}")
+        np.testing.assert_array_equal(l, tick[0][1], err_msg=f"t={t}")
+    return dict(h.bucket.stats)
+
+
+def test_pages_oom_mid_tick_spill_and_republish():
+    """aoi.pages:oom mid-walk: the tick spills to host, republishes
+    bit-exactly the SAME tick, and the pool re-arms."""
+    plan = faults.FaultPlan()
+    plan.add("aoi.pages", "oom", at=3)
+    st = _seamed_walk(plan)
+    assert st["page_spills"] >= 1
+    assert st["rebuilds"] == 0  # graceful: no device-state rebuild needed
+
+
+def test_pages_partial_spills_whole_tick():
+    plan = faults.FaultPlan()
+    plan.add("aoi.pages", "partial", at=2)
+    st = _seamed_walk(plan)
+    assert st["page_spills"] >= 1
+
+
+def test_pages_poison_shadow_rebuild():
+    """aoi.pages:poison corrupts the fetched page table; validation
+    catches it and the tick rides _recover_harvest's rebuild-from-host-
+    shadows -- still bit-exact, counted in poisoned + rebuilds."""
+    plan = faults.FaultPlan()
+    plan.add("aoi.pages", "poison", at=3)
+    st = _seamed_walk(plan)
+    assert st["poisoned"] >= 1
+    assert st["rebuilds"] >= 1 and st["host_ticks"] >= 1
+    assert st["calc_level"] == 0  # table corruption must not demote calc

@@ -686,6 +686,53 @@ def test_telemetry_rule_clean_catalog_and_skips_tests(tmp_path):
     assert findings == [], [f.render() for f in findings]
 
 
+# -- bounded-caps ------------------------------------------------------------
+
+CAPPED = """\
+    import jax.numpy as jnp
+
+    def silent_truncate(self):
+        buf = jnp.zeros((self._max_triples, 3), jnp.int32)
+        return buf
+
+    def counted(self):
+        buf = jnp.full((self._kcap,), -1, jnp.int32)
+        if self.n > self._kcap:
+            self.stats["decode_overflow"] += 1
+        return buf
+
+    def data_sized(self, idx):
+        # sized to the data, not a cap guess
+        return jnp.zeros((idx.shape[0],), jnp.int32)
+
+    def provably_fits(self):  # gwlint: allow[bounded-caps] -- one word per entity by construction
+        return jnp.zeros((self.capacity,), jnp.uint32)
+"""
+
+
+def test_bounded_caps_flags_uncounted_fixed_caps(tmp_path):
+    from goworld_tpu.analysis import bounded_caps
+
+    _mk(tmp_path, {"ops/buf.py": CAPPED})
+    findings, _ = _run(tmp_path, [bounded_caps.check])
+    got = {(f.path, f.line) for f in findings}
+    # only the silent truncation: the counted one has a stats bump, the
+    # data-sized one has no cap-like shape name, the last is allow'd
+    assert got == {("ops/buf.py", _ln(CAPPED, "_max_triples"))}
+    assert "counted overflow fallback" in findings[0].message
+
+
+def test_bounded_caps_out_of_scope_files_untouched(tmp_path):
+    from goworld_tpu.analysis import bounded_caps
+
+    _mk(tmp_path, {"services/cold.py":
+                   "import jax.numpy as jnp\n"
+                   "def f(self):\n"
+                   "    return jnp.zeros((self.max_n,), jnp.int32)\n"})
+    findings, _ = _run(tmp_path, [bounded_caps.check])
+    assert findings == []
+
+
 # -- the real tree -----------------------------------------------------------
 
 def test_repo_tree_is_clean_under_committed_suppressions():

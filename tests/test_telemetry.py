@@ -55,6 +55,8 @@ CANONICAL_NAMES = (
     "aoi.flush", "aoi.emit", "aoi.h2d", "aoi.stage", "aoi.kernel",
     "aoi.fetch", "aoi.diff", "aoi.decode", "aoi.host_tick", "aoi.buckets",
     "aoi.calc_level", "aoi.emit_path",
+    # paged ragged neighbor/event storage (ops/aoi_pages.py absorbers)
+    "aoi.pages", "aoi.page_occupancy", "aoi.page_spills",
     # live migration / chip-loss failover (engine/placement.py): start
     # spans, per-flush cover/swap + evacuation spans, totals
     "aoi.migrate", "aoi.migrate.snapshot", "aoi.migrate.replay",
@@ -443,6 +445,27 @@ def test_dispatchercluster_status_in_registry():
                % (cid, i))
         assert snap[key] == 0.0
     dc.stop()
+
+
+def test_dispatchercluster_dropped_counter_in_registry():
+    """Overflowing the outage buffer surfaces in the labeled registry
+    counter, not just status(): drop-oldest is counted, never silent."""
+    from goworld_tpu.dispatchercluster import DispatcherCluster
+    from goworld_tpu.netutil.packet import Packet
+
+    dc = DispatcherCluster([("127.0.0.1", 1)],
+                           on_packet=lambda i, pkt: None,
+                           register=lambda conn: None, tag="game1",
+                           pending_cap=4)
+    try:
+        for i in range(7):  # link down: all buffer; 3 past cap drop oldest
+            assert not dc.post(0, Packet(bytearray(b"p%d" % i)))
+        snap = telemetry.snapshot()
+        lbl = 'cluster="%d",disp="0",tag="game1"' % dc._telemetry_id
+        assert snap["disp.dropped{%s}" % lbl] == 3.0
+        assert snap["disp.pending{%s}" % lbl] == 4.0
+    finally:
+        dc.stop()
 
 
 # -- structured logs ---------------------------------------------------------
