@@ -1126,7 +1126,7 @@ def _timed(fn):
 
 def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
                  movers_frac=None, delta_staging=True, flush_sched=True,
-                 cap_mix=False, aoi_emit="auto"):
+                 cap_mix=False, aoi_emit="auto", cross_tick=False):
     """Engine-level number: ``Runtime.tick`` end-to-end.
 
     Movement drive:
@@ -1174,6 +1174,12 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     fan-out, the default) vs ``host`` (the original word-stream oracle).
     The A/B pair's ``parity_checksum`` must be bit-identical -- that fold
     IS the emit-path correctness artifact.
+
+    ``cross_tick`` turns on the engine-cadence one-tick deferral
+    (docs/perf.md cross-tick pipelining).  It shares the deferral with
+    ``pipeline``, so a ``cross_tick`` run's ``parity_checksum`` must
+    equal the ``pipeline`` run's on the same walk (same stream, same
+    single shift).
     """
     import jax
 
@@ -1201,7 +1207,8 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
 
     rt = Runtime(aoi_backend=backend, aoi_pipeline=pipeline,
                  aoi_delta_staging=delta_staging,
-                 aoi_flush_sched=flush_sched, aoi_emit=aoi_emit)
+                 aoi_flush_sched=flush_sched, aoi_emit=aoi_emit,
+                 aoi_cross_tick=cross_tick)
     rt.entities.register(BenchScene)
     rt.entities.register(BenchMob)
     rt.entities.register(BenchWatcher)
@@ -1369,7 +1376,8 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     for _name, _tid, _s0, _s1 in gwtrace.spans():
         span_s[_name] = span_s.get(_name, 0.0) + (_s1 - _s0)
     telemetry.disable()
-    kind = backend + ("+pipeline" if pipeline else "")
+    kind = backend + ("+pipeline" if pipeline else "") \
+        + ("+xtick" if cross_tick else "")
     if aoi_emit != "auto":
         kind += f"+emit={aoi_emit}"
     drive = "bulk move_entities" if bulk else "per-entity set_position"
@@ -1712,6 +1720,147 @@ def bench_engine_clustered(cfg, cap=2048, n=1800, ticks=8):
     }
 
 
+def _ingest_walk(cfg, batched, n, ticks, cross_tick=False, backend="tpu"):
+    """Drive one client-sync movement wave through a Runtime, arriving as
+    gate-flush-shaped wire packets; decode per-entity or batched.  The
+    wire frames are precomputed from a fixed rng so both A/B sides decode
+    byte-identical packets.  Returns (crc over normalized drained sync
+    records, walls, span seconds, ingest stats)."""
+    from goworld_tpu import telemetry
+    from goworld_tpu.engine.entity import Entity, GameClient
+    from goworld_tpu.engine.runtime import Runtime
+    from goworld_tpu.engine.space import Space
+    from goworld_tpu.engine.vector import Vector3
+    from goworld_tpu.ingest import (RECORD_SIZE, SYNC_RECORD,
+                                    MovementIngest, apply_per_entity)
+    from goworld_tpu.netutil import Packet
+    from goworld_tpu.telemetry import trace as gwtrace
+
+    class IngestScene(Space):
+        pass
+
+    class IngestWalker(Entity):
+        use_aoi = True
+        aoi_distance = cfg.radius
+
+    rt = Runtime(aoi_backend=backend, aoi_cross_tick=cross_tick)
+    rt.entities.register(IngestScene)
+    rt.entities.register(IngestWalker)
+    sc = rt.entities.create_space("IngestScene", kind=1)
+    sc.enable_aoi(cfg.radius)
+    rng = np.random.default_rng(11)
+    es, emap = [], {}
+    for i in range(n):
+        e = rt.entities.create(
+            "IngestWalker", space=sc,
+            pos=Vector3(rng.uniform(0, cfg.world), 0.0,
+                        rng.uniform(0, cfg.world)))
+        e.set_client_syncing(True)
+        e.set_client(GameClient(("b%06d" % i).ljust(16, "x")))
+        es.append(e)
+        emap[e.id] = i
+    rt.tick()  # prime: mass-enter replay (untimed)
+    # wire frames: entity ids are random per run, so the positions come
+    # from a run-independent rng and the eid column is filled per run --
+    # both sides of the A/B still decode byte-identical payload columns
+    eids = np.array([e.id.encode("ascii") for e in es], dtype="S16")
+    x = np.array([e.position.x for e in es], np.float32)
+    z = np.array([e.position.z for e in es], np.float32)
+    frng = np.random.default_rng(13)
+    frames = []
+    for _t in range(ticks):
+        x = np.clip(x + frng.uniform(-STEP, STEP, n).astype(np.float32),
+                    0, cfg.world)
+        z = np.clip(z + frng.uniform(-STEP, STEP, n).astype(np.float32),
+                    0, cfg.world)
+        rec = np.zeros(n, SYNC_RECORD)
+        rec["eid"], rec["x"], rec["z"] = eids, x, z
+        rec["yaw"] = frng.uniform(0, 6.28, n).astype(np.float32)
+        frames.append(rec.tobytes())
+    ing = MovementIngest(rt)
+    telemetry.enable()
+    gwtrace.reset()
+    crc, walls = 0, []
+    for frame in frames:
+        t0 = time.perf_counter()
+        pkt = Packet(bytearray(frame))
+        if batched:
+            ing.ingest(pkt)
+        else:
+            apply_per_entity(rt.entities, np.frombuffer(
+                pkt.read_view(n * RECORD_SIZE), dtype=SYNC_RECORD))
+        rt.tick()
+        walls.append(time.perf_counter() - t0)
+        rows = sorted((emap[eid], xx, yy, zz, yw) for _c, _g, eid,
+                      xx, yy, zz, yw in rt.drain_sync())
+        crc = zlib.crc32(
+            np.array(rows, np.float32).tobytes(), crc)
+    span_s: dict[str, float] = {}
+    for _name, _tid, _s0, _s1 in gwtrace.spans():
+        span_s[_name] = span_s.get(_name, 0.0) + (_s1 - _s0)
+    telemetry.disable()
+    return crc, walls, span_s, dict(ing.stats)
+
+
+def bench_engine_ingest(cfg, n=2048, ticks=12):
+    """Batched wire->column ingest A/B (docs/perf.md "Batched movement
+    ingest"): the same client-sync wave decoded through the per-entity
+    ``sync_position_yaw_from_client`` path, then through the columnar
+    ingest.  The drained sync streams must be crc-identical, and the
+    batched side must land with ZERO per-entity Python writes -- the
+    ingest stats are asserted, not just recorded."""
+    pe_crc, pe_walls, pe_span, _pe_st = _ingest_walk(
+        cfg, batched=False, n=n, ticks=ticks)
+    bt_crc, bt_walls, bt_span, bt_st = _ingest_walk(
+        cfg, batched=True, n=n, ticks=ticks)
+    assert bt_st["per_entity_writes"] == 0, bt_st  # the bench criterion
+    assert bt_st["batched"] == bt_st["records"] == n * ticks, bt_st
+
+    def _ms(walls):
+        return round(sum(walls) / len(walls) * 1e3, 2)
+
+    out = {
+        "metric": "engine_ingest",
+        "config": "engine_ingest",
+        "kind": "batched vs per-entity ingest A/B",
+        "value": round(n * ticks / sum(bt_walls)),
+        "unit": "moves/s",
+        "rate_kind": "e2e",
+        "detail": f"client-sync wire wave, 1 space x {n} entities, "
+                  f"{ticks} ticks, r={cfg.radius}, world={cfg.world}; "
+                  f"same packets decoded per-entity vs columnar",
+        "n_entities": n,
+        "ticks": ticks,
+        "ms_per_tick": _ms(bt_walls),
+        "per_entity_ms_per_tick": _ms(pe_walls),
+        "per_entity_moves_per_sec": round(n * ticks / sum(pe_walls)),
+        "phase_ms": {
+            "ingest": round(bt_span.get("aoi.ingest", 0.0) / ticks * 1e3, 3),
+            "kernel": round(bt_span.get("aoi.kernel", 0.0) / ticks * 1e3, 3),
+        },
+        "per_entity_phase_ms": {
+            "ingest": round(pe_span.get("aoi.ingest", 0.0) / ticks * 1e3, 3),
+            "kernel": round(pe_span.get("aoi.kernel", 0.0) / ticks * 1e3, 3),
+        },
+        "parity_ok": bt_crc == pe_crc,
+        "parity_checksum": f"{bt_crc:08x}",
+        "ingest_batched_frac": 1.0,
+        "per_entity_writes": bt_st["per_entity_writes"],
+        "ingest_bytes_per_tick": round(bt_st["bytes"] / ticks),
+    }
+    # same ratio the engine configs report: wall tick over the device
+    # kernel span -- the batched decode should pull it DOWN (less host
+    # time around the same device work)
+    if bt_span.get("aoi.kernel"):
+        out["wall_vs_device_ratio"] = round(
+            _ms(bt_walls) / max(
+                bt_span["aoi.kernel"] / ticks * 1e3, 1e-3), 2)
+        out["per_entity_wall_vs_device_ratio"] = round(
+            _ms(pe_walls) / max(
+                pe_span.get("aoi.kernel", 0.0) / ticks * 1e3, 1e-3), 2)
+    return out
+
+
 def bench_cpu(cfg, xs, zs):
     """CPU baseline: the native C++ sweep calculator when buildable (the
     fair equivalent of the reference's compiled go-aoi XZList), else the
@@ -1937,6 +2086,12 @@ def main():
                 # platform-agnostic like the two above -- the paged layout
                 # must retire the overflow class the capped one flags
                 emit(bench_engine_clustered(cfg))
+                # batched wire->column ingest A/B (docs/perf.md "Batched
+                # movement ingest"), platform-agnostic like the three
+                # above: the same client-sync wire wave decoded
+                # per-entity vs columnar -- crc-identical sync streams,
+                # zero per-entity Python writes asserted via ingest stats
+                emit(bench_engine_ingest(cfg))
                 import jax
 
                 if jax.default_backend() != "tpu":
@@ -1972,6 +2127,15 @@ def main():
                                   cap_mix=True, flush_sched=True))
                 emit(bench_engine(cfg, "tpu", pipeline=True, bulk=True,
                                   cap_mix=True, flush_sched=False))
+                # cross-tick pipelining A/B on the same cap_mix walk
+                # (docs/perf.md cross-tick pipelining): tick T+1's
+                # dispatch overlaps tick T's harvest at the engine
+                # cadence.  cross_tick and pipeline share the one-tick
+                # deferral, so this line's parity_checksum must equal
+                # the +pipeline+sched line's above -- same stream, same
+                # single shift, different overlap mechanism
+                emit(bench_engine(cfg, "tpu", bulk=True, cap_mix=True,
+                                  flush_sched=True, cross_tick=True))
                 out = bench_engine(cfg, "tpu", pipeline=True, bulk=True,
                                    movers_frac=0.1, delta_staging=False)
             else:

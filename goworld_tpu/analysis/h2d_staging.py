@@ -21,8 +21,17 @@ call
 from one.  Intentional sites take ``# gwlint: allow[h2d-staging]`` with a
 reason.
 
+The batched ingest (goworld_tpu/ingest/) is held to a stricter line: it
+is the wire->COLUMN half of the path and must stay entirely host-side --
+its columns reach the device only through the delta-staging seam at the
+next flush.  ANY upload call there (any argument, any function) is a
+finding: an ingest-time H2D would ship position data outside
+ops/aoi_stage's sparse-packet layout and double-upload every moved
+entity.
+
 Scope: the bucket modules (engine/aoi.py, engine/aoi_mesh.py,
-engine/aoi_rowshard.py).
+engine/aoi_rowshard.py) for the flush/dispatch shadow rule; ingest/ for
+the no-device rule.
 """
 
 from __future__ import annotations
@@ -34,6 +43,7 @@ from .core import Context, Finding, call_name
 RULE = "h2d-staging"
 
 SCOPE = ("engine/aoi.py", "engine/aoi_mesh.py", "engine/aoi_rowshard.py")
+INGEST_SCOPE = ("ingest/",)
 
 _UPLOAD_NAMES = {"jnp.asarray", "jnp.array", "jax.device_put",
                  "jax.numpy.asarray", "put"}
@@ -61,6 +71,21 @@ def _is_upload(node: ast.Call) -> bool:
 
 
 def check(ctx: Context):
+    # ingest/ must stay host-side: ANY upload there bypasses the staging
+    # seam (position data reaches the device only via ops/aoi_stage's
+    # sparse packets at the next flush)
+    for sf in ctx.files_matching(*INGEST_SCOPE):
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_upload(node)):
+                continue
+            yield Finding(
+                RULE, sf.rel, node.lineno, node.col_offset,
+                "device upload inside the ingest module: the batched "
+                "ingest is wire->column only -- position data reaches "
+                "the device through the delta-staging seam "
+                "(ops/aoi_stage) at the next flush, never at decode "
+                "time; move the upload or mark the line "
+                "'# gwlint: allow[h2d-staging] -- <why>'")
     for sf in ctx.files_matching(*SCOPE):
         for fn in ast.walk(sf.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \

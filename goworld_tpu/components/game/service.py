@@ -28,6 +28,7 @@ from ...engine.ids import fixed_id, gen_id
 from ...engine.runtime import Runtime
 from ...engine.space import Space
 from ...engine.vector import Vector3
+from ...ingest import MovementIngest
 from ...netutil import Packet
 from ...proto import GWConnection, msgtypes as MT
 from ...utils.asyncjobs import JobError
@@ -58,6 +59,8 @@ class GameService:
         self.rt.on_entity_registered = self._on_entity_registered
         self.rt.on_entity_unregistered = self._on_entity_unregistered
         self.rt.game = self  # entities reach cluster ops through this
+        # batched wire->column movement decode (goworld_tpu/ingest/)
+        self.ingest = MovementIngest(self.rt)
         self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=COMPONENT_QUEUE_MAX)
         self.cluster = DispatcherCluster(
             cfg.dispatcher_addrs(),
@@ -339,39 +342,12 @@ class GameService:
 
     def _h_sync_from_client(self, pkt):
         """Client position syncs arrive as one flat packet per gate flush;
-        decode straight into the bulk per-space apply
-        (Space.sync_entities_from_client) so the production ingest shape is
-        batched -- per-entity set_position stays for AI/logic moves
+        the batched ingest (goworld_tpu/ingest/) frombuffer-decodes the
+        whole record array and lands it in the per-space hot columns with
+        vectorized writes -- zero per-entity Python attribute writes on
+        the hot path; per-entity set_position stays for AI/logic moves
         (reference: GameService.go:398-410 flat array decode)."""
-        ents = self.rt.entities
-        groups: dict = {}  # space -> ([slots], [xs], [ys], [zs], [yaws])
-        while pkt.remaining() > 0:
-            eid = pkt.read_entity_id()
-            x = pkt.read_f32()
-            y = pkt.read_f32()
-            z = pkt.read_f32()
-            yaw = pkt.read_f32()
-            e = ents.get(eid)
-            if e is None or not e.client_syncing:
-                continue
-            sp = e.space
-            if sp is None:
-                continue
-            if e.aoi_slot < 0:
-                # not in the AOI arrays (mid-enter): the per-entity path
-                # still records the position
-                e.sync_position_yaw_from_client(Vector3(x, y, z), yaw)
-                continue
-            g = groups.get(sp)
-            if g is None:
-                g = groups[sp] = ([], [], [], [], [])
-            g[0].append(e.aoi_slot)
-            g[1].append(x)
-            g[2].append(y)
-            g[3].append(z)
-            g[4].append(yaw)
-        for sp, (slots, xs, ys, zs, yaws) in groups.items():
-            sp.sync_entities_from_client(slots, xs, ys, zs, yaws)
+        self.ingest.ingest(pkt)
 
     def _h_create_entity_anywhere(self, pkt):
         eid = pkt.read_entity_id()
@@ -878,6 +854,7 @@ class GameService:
                     other.interested_by.add(e)
                     if e.client is not None:
                         other._watcher_clients += 1
+                        other._touch_watched()
             from ...ops import aoi_predicate as AP
             import numpy as np
 

@@ -698,8 +698,20 @@ class AOIEngine:
                  tpu_min_capacity: int = 4096,
                  rowshard_min_capacity: int = 65536,
                  flush_sched: bool = True, emit: str = "auto",
-                 paged: bool = False):
+                 paged: bool = False, cross_tick: bool = False):
         self.default_backend = default_backend
+        # cross-tick pipelining (docs/perf.md): tick T+1's dispatch (pack
+        # + H2D + kernel enqueue on the double-buffered device state) runs
+        # while tick T harvests -- the device bucket parks each dispatched
+        # record one flush and delivers it at the next, buying near-100%
+        # device occupancy for ONE TICK of documented event latency.  The
+        # deferral is exactly the ``pipeline`` bucket contract, asserted
+        # engine-wide: cross_tick composes idempotently with pipeline
+        # (either flag defers; both together still defer exactly one
+        # tick), and the stream is bit-exact modulo the shift.  The
+        # row-sharded tier accepts the flag but stays synchronous (its
+        # flush is already a collective barrier -- see aoi_rowshard).
+        self.cross_tick = bool(cross_tick)
         # paged ragged event storage (docs/perf.md paged storage): the
         # device buckets compact their change stream into fixed-size pages
         # drawn from a shared on-device free list instead of a global
@@ -861,6 +873,7 @@ class AOIEngine:
 
                     bucket = _RowShardTPUBucket(
                         capacity, self.mesh, pipeline=self.pipeline,
+                        cross_tick=self.cross_tick,
                         delta_staging=self.delta_staging,
                         emit=self._resolve_emit(), paged=self.paged)
                     self._rowshard_serial += 1
@@ -870,10 +883,12 @@ class AOIEngine:
 
                     bucket = _MeshTPUBucket(
                         capacity, self.mesh, pipeline=self.pipeline,
+                        cross_tick=self.cross_tick,
                         delta_staging=self.delta_staging,
                         emit=self._resolve_emit(), paged=self.paged)
                 else:
                     bucket = _TPUBucket(capacity, pipeline=self.pipeline,
+                                        cross_tick=self.cross_tick,
                                         delta_staging=self.delta_staging,
                                         emit=self._resolve_emit(),
                                         paged=self.paged)
@@ -905,6 +920,7 @@ class AOIEngine:
 
             bucket = _RowShardTPUBucket(
                 capacity, self.mesh, pipeline=self.pipeline,
+                cross_tick=self.cross_tick,
                 delta_staging=self.delta_staging, emit=self._resolve_emit(),
                 paged=self.paged)
             self._rowshard_serial += 1
@@ -920,6 +936,7 @@ class AOIEngine:
 
                 bucket = _MeshTPUBucket(
                     capacity, self.mesh, pipeline=self.pipeline,
+                    cross_tick=self.cross_tick,
                     delta_staging=self.delta_staging,
                     emit=self._resolve_emit(), paged=self.paged)
                 self._buckets[key] = bucket
@@ -929,6 +946,7 @@ class AOIEngine:
             bucket = self._buckets.get(key)
             if bucket is None:
                 bucket = _TPUBucket(capacity, pipeline=self.pipeline,
+                                    cross_tick=self.cross_tick,
                                     delta_staging=self.delta_staging,
                                     emit=self._resolve_emit(),
                                     paged=self.paged)
@@ -1410,13 +1428,26 @@ class _TPUBucket(_Bucket):
     trade; parity is bit-exact modulo the shift -- tests/test_aoi_engine.py
     test_pipelined_flush_parity).  ``drain()`` harvests a pending tick
     without dispatching a new one (shutdown, state carry-over, tests).
+
+    ``cross_tick=True`` (the engine's ``aoi_cross_tick``) requests the
+    SAME one-tick deferral as the scheduler-level contract: tick T+1's
+    pack + H2D + kernel enqueue overlaps tick T's harvest because the
+    dispatched record parks one flush before delivering.  It composes
+    idempotently with ``pipeline`` -- either flag (or both) defers by
+    exactly one tick, so every flag combination stays bit-exact modulo
+    the same single shift (tests/test_cross_tick.py).  Fault recovery is
+    unchanged: a fault during T's harvest cannot corrupt T+1's already-
+    dispatched state because _recover/_recover_harvest rebuild from the
+    columnar host shadows and re-park synthetic host records
+    (docs/robustness.md).
     """
 
     def __init__(self, capacity: int, pipeline: bool = False,
                  delta_staging: bool = True, emit: str = "vector",
-                 paged: bool = False):
+                 paged: bool = False, cross_tick: bool = False):
         super().__init__(capacity)
         self.pipeline = pipeline
+        self.cross_tick = bool(cross_tick)
         self.delta_staging = delta_staging
         # paged ragged storage (docs/perf.md paged storage): the change
         # stream compacts into fixed-size pages from an on-device free
@@ -1558,6 +1589,15 @@ class _TPUBucket(_Bucket):
         # attribute engine ms/tick between host logic, wire, and decode.
         self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0,
                      "emit_s": 0.0}
+
+    @property
+    def _defer(self) -> bool:
+        """One-tick event deferral in effect.  ``pipeline`` and
+        ``cross_tick`` request the SAME deferral mechanics (park the
+        dispatched record one flush, prefetch its D2H async), so either
+        flag -- or both -- shifts delivery by exactly one tick and the
+        parity contract stays a single shift for every combination."""
+        return self.pipeline or self.cross_tick
 
     @property
     def _steady(self) -> bool:
@@ -1934,7 +1974,7 @@ class _TPUBucket(_Bucket):
                 "all_unsub": all_unsub,
                 "prefetch": None,
             }
-            if self.pipeline and not all_unsub:
+            if self._defer and not all_unsub:
                 # optimistic page prefetch: the used prefix rides the wire
                 # while the host runs the next tick; harvest refetches on
                 # a misfit
@@ -1945,7 +1985,7 @@ class _TPUBucket(_Bucket):
                 rec["prefetch"] = (ndp, sl_pg)
             prev_rec, self._inflight = self._inflight, rec
             self.perf["stage_s"] += time.perf_counter() - t_stage0
-            if self.pipeline:
+            if self._defer:
                 if prev_rec is not None:
                     self._sched = ("rec", prev_rec)
             else:
@@ -1971,7 +2011,7 @@ class _TPUBucket(_Bucket):
                 "all_unsub": all_unsub,
                 "prefetch": None,
             }
-            if self.pipeline and not all_unsub:
+            if self._defer and not all_unsub:
                 # optimistic triple prefetch: D2H rides the wire while the
                 # host runs the next tick; harvest refetches on a misfit
                 ndp = min(mt, self._pred_tri)
@@ -1980,7 +2020,7 @@ class _TPUBucket(_Bucket):
                 rec["prefetch"] = (ndp, sl_tri)
             prev_rec, self._inflight = self._inflight, rec
             self.perf["stage_s"] += time.perf_counter() - t_stage0
-            if self.pipeline:
+            if self._defer:
                 if prev_rec is not None:
                     self._sched = ("rec", prev_rec)
             else:
@@ -2014,7 +2054,7 @@ class _TPUBucket(_Bucket):
             "all_unsub": all_unsub,
             "prefetch": None,
         }
-        if self.pipeline and not all_unsub:
+        if self._defer and not all_unsub:
             # optimistic prefetch at the recent ticks' observed stream sizes:
             # the D2H rides the wire while the host runs the next tick's
             # logic; the harvest refetches exact slices on a misfit (rare --
@@ -2032,7 +2072,7 @@ class _TPUBucket(_Bucket):
             rec["prefetch"] = (ndp, escp, excp, slices)
         prev_rec, self._inflight = self._inflight, rec
         self.perf["stage_s"] += time.perf_counter() - t_stage0
-        if self.pipeline:
+        if self._defer:
             # tick T dispatched; T-1's record (whose D2H was prefetched at
             # its own dispatch) harvests in phase 2
             if prev_rec is not None:
@@ -2281,10 +2321,11 @@ class _TPUBucket(_Bucket):
         ent_vals = chg_vals & new.reshape(-1)[gidx]
         self._mirror[sl] = new
         epochs = [self._slot_epoch.get(s, 0) for s in slots]
-        if self.pipeline and not publish_now:
-            # pipelined cadence: events are delivered one tick late, so a
-            # recovered tick parks as a synthetic inflight record and
-            # publishes at the NEXT flush, exactly like a device tick
+        if self._defer and not publish_now:
+            # deferred cadence (pipeline/cross_tick): events are delivered
+            # one tick late, so a recovered tick parks as a synthetic
+            # inflight record and publishes at the NEXT flush, exactly like
+            # a device tick
             self._inflight = {"host": True, "slots": slots,
                               "epochs": epochs,
                               "payload": (chg_vals, ent_vals, gidx, s_n)}

@@ -79,7 +79,7 @@ class _MeshTPUBucket(_Bucket):
 
     def __init__(self, capacity: int, mesh, pipeline: bool = False,
                  delta_staging: bool = True, emit: str = "vector",
-                 paged: bool = False):
+                 paged: bool = False, cross_tick: bool = False):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
 
@@ -104,6 +104,9 @@ class _MeshTPUBucket(_Bucket):
         self.mesh = mesh  # parallel.SpaceMesh
         self.n_dev = mesh.n_devices
         self.pipeline = pipeline
+        # cross_tick composes with pipeline idempotently: either flag (or
+        # both) defers delivery by exactly one tick (see _TPUBucket._defer)
+        self.cross_tick = bool(cross_tick)
         self.delta_staging = delta_staging
         self.s_max = 0
         self.prev = None  # [S, C, W] uint32, sharded over axis 0
@@ -191,6 +194,12 @@ class _MeshTPUBucket(_Bucket):
         self._pred = (256, 64, 256)
         self.perf = {"stage_s": 0.0, "fetch_s": 0.0, "decode_s": 0.0,
                      "emit_s": 0.0}
+
+    @property
+    def _defer(self) -> bool:
+        """One-tick event deferral in effect (pipeline OR cross_tick --
+        see aoi._TPUBucket._defer for the composition contract)."""
+        return self.pipeline or self.cross_tick
 
     @property
     def _steady(self) -> bool:
@@ -744,7 +753,7 @@ class _MeshTPUBucket(_Bucket):
         # (faults.DeviceLost; dispatch()'s handler marks the bucket
         # evacuating after the standard host-side recovery)
         faults.check("aoi.device")
-        if self.pipeline and self._inflight is not None \
+        if self._defer and self._inflight is not None \
                 and not self._inflight.get("all_unsub") \
                 and not self._inflight.get("host"):
             # peek the inflight tick's scalars (async-fetched at its
@@ -823,7 +832,7 @@ class _MeshTPUBucket(_Bucket):
             "all_unsub": all_unsub,
             "prefetch": None,
         }
-        if self.pipeline and not all_unsub:
+        if self._defer and not all_unsub:
             # optimistic per-chip prefetch at recently observed stream
             # sizes; the harvest refetches exact slices on a misfit (an
             # all-unsubscribed tick's stream is empty by construction --
@@ -849,7 +858,7 @@ class _MeshTPUBucket(_Bucket):
             rec["prefetch"] = (ndp, escp, excp, slices)
         prev_rec, self._inflight = self._inflight, rec
         self.perf["stage_s"] += time.perf_counter() - t0
-        if self.pipeline:
+        if self._defer:
             if prev_rec is not None:
                 self._sched = ("rec", prev_rec)
         else:
@@ -1073,9 +1082,10 @@ class _MeshTPUBucket(_Bucket):
         ent_vals = chg_vals & new.reshape(-1)[gidx]
         self._mirror[sl] = new
         epochs = [self._slot_epoch.get(s, 0) for s in slots]
-        if self.pipeline and not publish_now:
-            # pipelined cadence: events deliver one tick late, so the
-            # recovered tick parks as a synthetic inflight record
+        if self._defer and not publish_now:
+            # deferred cadence (pipeline/cross_tick): events deliver one
+            # tick late, so the recovered tick parks as a synthetic
+            # inflight record
             self._inflight = {"host": True, "slots": slots,
                               "epochs": epochs,
                               "payload": (chg_vals, ent_vals, gidx, s_n)}
@@ -1174,7 +1184,7 @@ class _MeshTPUBucket(_Bucket):
                 chg_h = np.asarray(chg[lo:lo + s_local]).reshape(-1)
                 gidx = np.nonzero(chg_h)[0]
                 chg_vals = chg_h[gidx]
-                if self.pipeline and self._mirror is not None:
+                if self._defer and self._mirror is not None:
                     # prev was donated to the NEXT dispatch already; the
                     # pre-XOR mirror still holds this tick's old words, so
                     # new = old ^ chg reconstructs the enter/leave split

@@ -66,7 +66,7 @@ class _RowShardTPUBucket(_Bucket):
 
     def __init__(self, capacity: int, mesh, pipeline: bool = False,
                  delta_staging: bool = True, emit: str = "vector",
-                 paged: bool = False):
+                 paged: bool = False, cross_tick: bool = False):
         super().__init__(capacity)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
 
@@ -93,6 +93,7 @@ class _RowShardTPUBucket(_Bucket):
                 f"n_dev*128 = {self.n_dev * 128}")
         self.c_local = capacity // self.n_dev
         self.pipeline = pipeline  # accepted for symmetry; flush is sync
+        self.cross_tick = bool(cross_tick)  # likewise: never deferred here
         self.prev = None  # [C, W] uint32, rows sharded over the mesh
         # persistent staged inputs [C]; unstaged flushes step nothing
         self._hx = np.zeros(capacity, np.float32)

@@ -82,7 +82,17 @@ class _AttrNode:
     @staticmethod
     def _wrap(value: Any) -> Any:
         """Uniformize plain containers into attr nodes (reference:
-        attr.go:39-75 type uniformization)."""
+        attr.go:39-75 type uniformization).
+
+        Hot/cold boundary (engine/ecs.py): live column VIEWS (an object
+        exposing ``__attr_plain__``, e.g. Entity.position's PositionView)
+        are snapshotted BY VALUE here.  The attr tree is the COLD path --
+        it serializes, diffs and replicates; aliasing mutable column
+        state into it would make saved/replicated attrs drift with every
+        batched move."""
+        plain = getattr(value, "__attr_plain__", None)
+        if plain is not None:
+            value = plain()
         if isinstance(value, dict):
             m = MapAttr()
             for k, v in value.items():
@@ -108,6 +118,9 @@ class _AttrNode:
             return {k: _AttrNode._plain(v) for k, v in value._data.items()}
         if isinstance(value, ListAttr):
             return [_AttrNode._plain(v) for v in value._data]
+        plain = getattr(value, "__attr_plain__", None)
+        if plain is not None:
+            return plain()
         return value
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, Any, Iterable
 
 from .attrs import MapAttr
+from .ecs import PositionView
 from .vector import Vector3
 
 if TYPE_CHECKING:
@@ -102,8 +103,14 @@ class Entity:
         self.desc: "EntityTypeDesc | None" = None
         self.attrs = MapAttr()
         self.attrs._owner = self
-        self.position = Vector3()
-        self.yaw: float = 0.0
+        # ECS hot/cold split (engine/ecs.py): position and yaw are HOT --
+        # while the entity holds an AOI slot they live in the space's
+        # columns and these fields are views/fallbacks.  _pos is the
+        # detached f64 snapshot (authoritative while slotless); the
+        # PositionView reads/writes through to the columns when slotted.
+        self._pos = Vector3()
+        self._pos_view = PositionView(self)
+        self._yaw: float = 0.0
         self.space: "Space | None" = None
         self.aoi_slot: int = -1  # slot in the space's arrays while in a space
         self.interested_in: set[Entity] = set()
@@ -147,6 +154,16 @@ class Entity:
             )
         if self.aoi_slot >= 0 and self.space is not None:
             self.space._nonplain[self.aoi_slot] = not self._plain_aoi
+
+    def _touch_watched(self):
+        """Mirror "some client can see this entity" into the space's
+        ``watched`` column (engine/ecs.py) -- the vectorized ingest path's
+        sync drain filters flagged movers by it, so it must track every
+        _watcher_clients / client transition while slotted."""
+        slot = self.aoi_slot
+        if slot >= 0 and self.space is not None:
+            self.space._cols.watched[slot] = (
+                self._watcher_clients > 0 or self.client is not None)
 
     @property
     def is_space(self) -> bool:
@@ -246,18 +263,58 @@ class Entity:
                         other.client.attr_delta(self.id, path, op, value)
 
     # -- position / AOI ----------------------------------------------------
+    @property
+    def position(self) -> PositionView:
+        """The entity's position as a live view: component access reads
+        the space's columns while the entity holds an AOI slot (f32, the
+        AOI boundary precision), the detached f64 snapshot otherwise.
+        It IS a Vector3 (subclass), so equality/arithmetic keep working."""
+        return self._pos_view
+
+    @position.setter
+    def position(self, pos: Vector3):
+        # plain assignment: update value only (no sync flags -- that is
+        # set_position's job).  Read components FIRST: ``pos`` may be this
+        # entity's own view.
+        x, y, z = pos.x, pos.y, pos.z
+        p = self._pos
+        p.x = x
+        p.y = y
+        p.z = z
+        slot = self.aoi_slot
+        if slot >= 0:
+            sp = self.space
+            if sp is not None:
+                cols = sp._cols
+                cols.x[slot] = x
+                cols.y[slot] = y
+                cols.z[slot] = z
+                sp._aoi_dirty = True
+
+    @property
+    def yaw(self) -> float:
+        slot = self.aoi_slot
+        if slot >= 0:
+            sp = self.space
+            if sp is not None:
+                return float(sp._cols.yaw[slot])
+        return self._yaw
+
+    @yaw.setter
+    def yaw(self, v: float):
+        v = float(v)
+        self._yaw = v
+        slot = self.aoi_slot
+        if slot >= 0:
+            sp = self.space
+            if sp is not None:
+                sp._cols.yaw[slot] = v
+
     def set_position(self, pos: Vector3):
         # the single hottest host call in the engine (once per entity move
         # per tick); space.move_entity is inlined and the dirty-set add uses
         # the cached stable set
         self.position = pos
-        sp = self.space
-        if sp is not None:
-            slot = self.aoi_slot
-            if slot >= 0:
-                sp._x[slot] = pos.x
-                sp._z[slot] = pos.z
-                sp._aoi_dirty = True
         if self.client_syncing:
             self._sync_flags |= SYNC_NEIGHBORS
         else:
@@ -297,6 +354,7 @@ class Entity:
             other._flush_attr_deltas()
         if other not in self.interested_in and self.client is not None:
             other._watcher_clients += 1
+            other._touch_watched()
         self.interested_in.add(other)
         other.interested_by.add(self)
         if self.client is not None:
@@ -306,6 +364,7 @@ class Entity:
     def _uninterest(self, other: "Entity"):
         if other in self.interested_in and self.client is not None:
             other._watcher_clients -= 1
+            other._touch_watched()
         self.interested_in.discard(other)
         other.interested_by.discard(self)
         if self.client is not None:
@@ -361,7 +420,9 @@ class Entity:
             return
         for other in self.interested_in:
             other._watcher_clients -= 1
+            other._touch_watched()
         self.client = None
+        self._touch_watched()
         self._recompute_plain()
         if self._plain_aoi:
             self._dematerialize_interests()
@@ -374,7 +435,9 @@ class Entity:
             for other in self.interested_in:
                 old.destroy_entity(other)
                 other._watcher_clients -= 1
+                other._touch_watched()
             self.client = None
+            self._touch_watched()
             self.on_client_disconnected()
         if client is not None:
             if was_plain:
@@ -383,12 +446,14 @@ class Entity:
                 self._materialize_interests()
             for other in self.interested_in:
                 other._watcher_clients += 1
+                other._touch_watched()
             # flush pending deltas to the old audiences first -- the
             # snapshots below already contain them (see _interest)
             self._flush_attr_deltas()
             for other in self.interested_in:
                 other._flush_attr_deltas()
             self.client = client
+            self._touch_watched()
             client.create_entity(self, is_player=True)
             for other in self.interested_in:
                 client.create_entity(other, is_player=False)

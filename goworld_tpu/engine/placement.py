@@ -60,11 +60,13 @@ class MigrationError(RuntimeError):
 
 
 def _lag(bucket) -> int:
-    """Event-delivery lag of a bucket in flushes: 1 for a pipelined device
-    bucket (events one tick late), else 0.  The row-sharded bucket accepts
-    ``pipeline`` for symmetry but flushes synchronously (no ``_inflight``),
-    and host buckets publish inline."""
-    return 1 if (getattr(bucket, "pipeline", False)
+    """Event-delivery lag of a bucket in flushes: 1 for a deferred device
+    bucket -- ``pipeline`` or ``cross_tick``, which shift delivery by the
+    same single tick (aoi._TPUBucket._defer) -- else 0.  The row-sharded
+    bucket accepts both flags for symmetry but flushes synchronously (no
+    ``_inflight``), and host buckets publish inline."""
+    return 1 if ((getattr(bucket, "pipeline", False)
+                  or getattr(bucket, "cross_tick", False))
                  and hasattr(bucket, "_inflight")) else 0
 
 

@@ -52,6 +52,7 @@ class Runtime:
         aoi_flush_sched: bool = True,
         aoi_emit: str = "auto",
         aoi_paged: bool = False,
+        aoi_cross_tick: bool = False,
         aoi_placement: str = "static",
         aoi_migration_threshold_ms: float = 5.0,
         aoi_migration_cooldown: int = 64,
@@ -80,7 +81,7 @@ class Runtime:
                              tpu_min_capacity=aoi_tpu_min_capacity,
                              rowshard_min_capacity=aoi_rowshard_min_capacity,
                              flush_sched=aoi_flush_sched, emit=aoi_emit,
-                             paged=aoi_paged)
+                             paged=aoi_paged, cross_tick=aoi_cross_tick)
         # telemetry-driven placement (engine/placement.py): "static" keeps
         # spaces where capacity routing put them (migrate() stays available
         # as the operator entry point); "auto" re-homes hot/idle spaces
@@ -95,6 +96,11 @@ class Runtime:
         # the sync phase walks ONLY these (reference scans every entity each
         # tick -- Entity.go:1221-1267 -- which compiled Go affords)
         self._dirty_entities: set[Entity] = set()
+        # spaces whose sync COLUMN holds pending flags (vectorized ingest
+        # writes -- engine/ecs.py): drained at the head of the sync phase
+        # into the per-entity dirty machinery.  A dict used as an ordered
+        # set: drain order stays insertion order (deterministic)
+        self._col_sync_spaces: dict = {}
         # position sync records collected this tick:
         # (client_id, gate_id, entity_id, x, y, z, yaw)
         self.sync_out: list[tuple] = []
@@ -164,6 +170,14 @@ class Runtime:
         a reference to it (Entity._dirty_set) -- so it is drained in place,
         never swapped.  The common steady-state case (no client, nobody's
         client watching) exits after two integer tests."""
+        # fold pending sync-column flags (batched ingest) into the dirty
+        # machinery first, so batched and per-entity movement emit through
+        # one path -- exactly-once per entity per tick
+        css = self._col_sync_spaces
+        if css:
+            for sp in css:
+                sp.drain_column_sync()
+            css.clear()
         ds = self._dirty_entities
         if not ds:
             return

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from .ecs import ColumnStore
 from .entity import Entity
 from .vector import Vector3
 
@@ -39,23 +40,19 @@ class Space(Entity):
         self.entities: set[Entity] = set()
         self._aoi_handle = None
         self._aoi_default_dist = 0.0
-        # packed per-slot arrays (capacity-sized, grown by doubling)
+        # columnar ECS store (engine/ecs.py): the hot per-slot attributes
+        # (x/z/r/act/nonplain + the y/yaw/sync/watched host companions)
+        # as capacity-sized arrays grown by doubling.  Entities hold VIEWS
+        # into these columns while slotted (Entity.position); submit_aoi
+        # hands the calculator the columns themselves, so the flush()
+        # delta diff reads them directly -- no per-entity walk anywhere
         self._cap = 0
-        self._x = np.empty(0, np.float32)
-        self._z = np.empty(0, np.float32)
-        self._r = np.empty(0, np.float32)
-        self._act = np.empty(0, bool)
+        self._cols = ColumnStore()
         self._slot_entity: list[Entity | None] = []
         # numpy object-array mirror of _slot_entity: event replay fancy-
         # indexes whole pair columns at C speed instead of per-pair list
         # lookups (dispatch_aoi_events)
         self._slot_np = np.empty(0, object)
-        # per-slot flag: observer needs eager event replay (has a client or
-        # overridden AOI hooks).  Pairs whose observer is PLAIN are dropped
-        # before the replay loop -- their interest state lives in the
-        # calculator's packed words and materializes on demand
-        # (derive_interests; Entity.neighbors)
-        self._nonplain = np.zeros(0, bool)
         self._free_slots: list[int] = []
         # two-stage cooling for freed slots: a pipelined calculator's events
         # for a slot freed during tick T are dispatched at T and only
@@ -79,6 +76,29 @@ class Space(Entity):
     @property
     def is_nil(self) -> bool:
         return self.kind == 0
+
+    # legacy accessors for the packed arrays -- the columns ARE the
+    # arrays now (ColumnStore); kept so calculators, tests and tools that
+    # index `space._x[slot]` keep working against the live column
+    @property
+    def _x(self) -> np.ndarray:
+        return self._cols.x
+
+    @property
+    def _z(self) -> np.ndarray:
+        return self._cols.z
+
+    @property
+    def _r(self) -> np.ndarray:
+        return self._cols.r
+
+    @property
+    def _act(self) -> np.ndarray:
+        return self._cols.act
+
+    @property
+    def _nonplain(self) -> np.ndarray:
+        return self._cols.nonplain
 
     def on_space_init(self):  # user hook (reference ISpace)
         pass
@@ -117,21 +137,11 @@ class Space(Entity):
         new_cap = max(_MIN_CAPACITY, self._cap or _MIN_CAPACITY)
         while new_cap < n:
             new_cap *= 2
-        for name in ("_x", "_z", "_r"):
-            arr = getattr(self, name)
-            grown = np.zeros(new_cap, np.float32)
-            grown[: len(arr)] = arr
-            setattr(self, name, grown)
-        act = np.zeros(new_cap, bool)
-        act[: len(self._act)] = self._act
-        self._act = act
+        self._cols.ensure_capacity(new_cap)
         self._slot_entity.extend([None] * (new_cap - len(self._slot_entity)))
         slot_np = np.empty(new_cap, object)
         slot_np[: len(self._slot_np)] = self._slot_np
         self._slot_np = slot_np
-        nonplain = np.zeros(new_cap, bool)
-        nonplain[: len(self._nonplain)] = self._nonplain
-        self._nonplain = nonplain
         old_cap = self._cap
         self._cap = new_cap
         if self._aoi_handle is not None and old_cap:
@@ -164,13 +174,19 @@ class Space(Entity):
             e.aoi_slot = slot
             self._slot_entity[slot] = e
             self._slot_np[slot] = e
-            self._nonplain[slot] = not e._plain_aoi
-            self._x[slot] = pos.x
-            self._z[slot] = pos.z
-            self._r[slot] = (
+            cols = self._cols
+            cols.nonplain[slot] = not e._plain_aoi
+            cols.x[slot] = pos.x
+            cols.y[slot] = pos.y
+            cols.z[slot] = pos.z
+            cols.yaw[slot] = e._yaw
+            cols.r[slot] = (
                 e.aoi_distance if e.aoi_distance > 0 else self._aoi_default_dist
             )
-            self._act[slot] = True
+            cols.act[slot] = True
+            cols.sync[slot] = 0
+            cols.watched[slot] = (e._watcher_clients > 0
+                                  or e.client is not None)
             self._aoi_dirty = True
         if not is_restore:
             self.on_entity_enter_space(e)
@@ -189,10 +205,19 @@ class Space(Entity):
             return
         if e.aoi_slot >= 0:
             slot = e.aoi_slot
-            self._act[slot] = False
+            cols = self._cols
+            # detach the entity's position/yaw views: snapshot the column
+            # values back into the f64 Vector3 the views fall through to
+            # (batched moves and ingest write columns only, so the
+            # snapshot may be the ONLY up-to-date copy)
+            p = e._pos
+            p.x = float(cols.x[slot])
+            p.y = float(cols.y[slot])
+            p.z = float(cols.z[slot])
+            e._yaw = float(cols.yaw[slot])
+            cols.clear_slot(slot)
             self._slot_entity[slot] = None
             self._slot_np[slot] = None
-            self._nonplain[slot] = False
             self._free_cooling.append(slot)
             e.aoi_slot = -1
             self._aoi_dirty = True
@@ -215,56 +240,39 @@ class Space(Entity):
         """Batched position update: one call moves many entities (reference
         analog: the gate->game client-sync path decodes a flat array of
         positions and applies them in one pass, GameService.go:398-410).
-        Array writes are vectorized; per entity only the position object is
-        mutated IN PLACE (no allocation) and sync bookkeeping runs just for
-        entities some client can actually see.  This is the device-cadence
-        movement path: at 64k entities it costs ~20 ms where per-entity
-        set_position costs ~100 ms.
+        All position/yaw writes are vectorized column writes (entities
+        VIEW the columns -- engine/ecs.py -- so nothing per-entity needs
+        updating); sync bookkeeping runs just for entities some client can
+        actually see.  This is the device-cadence movement path: at 64k
+        entities it costs ~20 ms where per-entity set_position costs
+        ~100 ms.  (The fully-batched wire path, goworld_tpu/ingest/,
+        replaces even the bookkeeping loop with a sync-column write.)
 
         With ``ys``/``yaws`` (the client-sync ingest,
-        sync_entities_from_client) height and yaw update too; the two loops
-        differ ONLY in those extra writes -- keep the bookkeeping block
-        identical (the yaw branch stays out of the hot server-move loop)."""
+        sync_entities_from_client) height and yaw update too."""
         slots = np.asarray(slots, np.int64)
-        self._x[slots] = xs
-        self._z[slots] = zs
+        cols = self._cols
+        cols.x[slots] = xs
+        cols.z[slots] = zs
+        if ys is not None:
+            cols.y[slots] = ys
+            cols.yaw[slots] = yaws
         self._aoi_dirty = True
         se = self._slot_np
-        # two loop bodies, same skeleton: the position writes differ, the
-        # trailing sync-bookkeeping block must stay IDENTICAL (client-driven
-        # entities get no owner echo -- same rule as set_position: correcting
-        # the owner fights client-side prediction; server-driven ones do).
-        # Inlined, not a helper: a per-entity call costs ~5 ms at 64k on the
+        # sync bookkeeping (client-driven entities get no owner echo --
+        # same rule as set_position: correcting the owner fights
+        # client-side prediction; server-driven ones do).  Inlined, not a
+        # helper: a per-entity call costs ~5 ms at 64k on the
         # device-cadence path.
-        if ys is None:
-            for s, x, z in zip(slots.tolist(), np.asarray(xs).tolist(),
-                               np.asarray(zs).tolist()):
-                e = se[s]
-                if e is None:
-                    continue
-                p = e.position
-                p.x = x
-                p.z = z
-                if e._watcher_clients > 0 or e.client is not None:
-                    e._sync_flags |= 2 if e.client_syncing else 3
-                    ds = e._dirty_set
-                    if ds is not None:
-                        ds.add(e)
-        else:
-            for s, x, z, y, yaw in zip(slots.tolist(), xs, zs, ys, yaws):
-                e = se[s]
-                if e is None:
-                    continue
-                p = e.position
-                p.x = x
-                p.y = y
-                p.z = z
-                e.yaw = yaw
-                if e._watcher_clients > 0 or e.client is not None:
-                    e._sync_flags |= 2 if e.client_syncing else 3
-                    ds = e._dirty_set
-                    if ds is not None:
-                        ds.add(e)
+        for s in slots.tolist():
+            e = se[s]
+            if e is None:
+                continue
+            if e._watcher_clients > 0 or e.client is not None:
+                e._sync_flags |= 2 if e.client_syncing else 3
+                ds = e._dirty_set
+                if ds is not None:
+                    ds.add(e)
 
     def sync_entities_from_client(self, slots, xs, ys, zs, yaws):
         """Batched client-driven position/yaw sync: the gate->game sync
@@ -279,12 +287,10 @@ class Space(Entity):
 
     def move_entity(self, e: Entity, pos: Vector3):
         """Reference: Space.move, Space.go:253-261.  (Entity.set_position
-        inlines this; other callers use it directly.)"""
+        inlines this; other callers use it directly.)  The position
+        assignment writes the columns and marks AOI dirty when slotted
+        (Entity.position setter)."""
         e.position = pos
-        if e.aoi_slot >= 0:
-            self._x[e.aoi_slot] = pos.x
-            self._z[e.aoi_slot] = pos.z
-            self._aoi_dirty = True
 
     # -- per-tick AOI ------------------------------------------------------
     def recycle_aoi_slots(self):
@@ -308,13 +314,44 @@ class Space(Entity):
         # observer is plain are dropped at delivery anyway, so an all-plain
         # space needs no event stream at all -- the calculator skips its
         # extraction/fetch/decode and interest state derives on demand
-        sub = bool(self._nonplain[: self._slot_watermark].any())
+        cols = self._cols
+        sub = bool(cols.nonplain[: self._slot_watermark].any())
         if sub != self._aoi_subscribed:
             self._aoi_subscribed = sub
             aoi.set_subscribed(self._aoi_handle, sub)
-        aoi.submit(self._aoi_handle, self._x, self._z, self._r, self._act)
+        # the columns ARE the staged arrays: flush()'s delta diff
+        # (engine/aoi._stage_inputs) reads them directly against the host
+        # shadows -- wire/logic writes land here vectorized and nothing
+        # walks entities between a move and the H2D packet
+        aoi.submit(self._aoi_handle, cols.x, cols.z, cols.r, cols.act)
         self._aoi_dirty = False
         return True
+
+    def drain_column_sync(self):
+        """Fold pending column sync flags (set vectorized by the batched
+        ingest path, goworld_tpu/ingest/) into the per-entity sync
+        machinery.  One vectorized scan finds flagged slots; only WATCHED
+        movers (some client can see them -- the ``watched`` column) pay a
+        per-entity visit, which routes through ``_sync_flags`` +
+        the dirty set so records emit exactly once per entity per tick
+        even when batched and per-entity writes mix."""
+        cols = self._cols
+        sf = cols.sync[: self._slot_watermark]
+        idx = np.nonzero(sf)[0]
+        if not idx.size:
+            return
+        flags = sf[idx].copy()
+        sf[idx] = 0
+        w = cols.watched[idx]
+        se = self._slot_np
+        for s, f in zip(idx[w].tolist(), flags[w].tolist()):
+            e = se[s]
+            if e is None or e.destroyed:
+                continue
+            e._sync_flags |= f
+            ds = e._dirty_set
+            if ds is not None:
+                ds.add(e)
 
     def dispatch_aoi_events(self):
         """Replay batched enter/leave pairs through entity interest hooks.

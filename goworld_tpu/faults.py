@@ -48,6 +48,11 @@ seam                      fires in
                           evacuating, and the engine rebuilds its spaces
                           onto surviving devices (docs/robustness.md
                           live migration & failover)
+``aoi.ingest``            batched wire->column movement decode
+                          (goworld_tpu/ingest/): any kind demotes the
+                          whole batch to the per-entity apply path --
+                          bit-identical semantics, counted in the
+                          ingest fallback stats
 ``aoi.pages``             paged-storage allocator at harvest (paged
                           buckets, docs/perf.md): ``oom``/``fail``/
                           ``partial`` = pool exhaustion -- the bucket
@@ -112,6 +117,8 @@ SEAMS = {
     "aoi.pages": "paged-storage allocator at harvest (oom/fail/partial = "
                  "counted whole-tick spill + pool re-arm; poison = page-"
                  "table corruption caught by validation -> shadow rebuild)",
+    "aoi.ingest": "batched wire->column movement decode (any kind demotes "
+                  "the batch to the per-entity apply path, bit-identical)",
     "conn.send": "typed packet send",
     "conn.flush": "framed batch write",
     "conn.recv": "blocking packet read",

@@ -1,0 +1,14 @@
+"""Gate-to-device ingest: wire bytes -> columnar store, no per-entity hops.
+
+The reference decodes each client sync record into an entity method call
+(GameService.go:398-410); this package decodes the flat record array with
+one ``np.frombuffer`` and lands it in the per-space hot columns
+(engine/ecs.py) with vectorized writes -- the wire->column->H2D path has
+ZERO per-entity Python attribute writes (docs/perf.md, batched ingest).
+"""
+
+from .movement import (RECORD_SIZE, SYNC_RECORD, MovementIngest,
+                       apply_per_entity)
+
+__all__ = ["MovementIngest", "SYNC_RECORD", "RECORD_SIZE",
+           "apply_per_entity"]

@@ -55,7 +55,14 @@ JAX_PLATFORMS=cpu python scripts/emit_smoke.py || fail=1
 echo "== migration smoke =="
 JAX_PLATFORMS=cpu python scripts/migration_smoke.py || fail=1
 
-# 9. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+# 9. batched-ingest smoke (CPU backend: the same client-sync wire wave
+#    decoded per-entity vs columnar vs columnar+cross-tick; identical
+#    sync records and event-pair CRC, zero per-entity Python writes --
+#    docs/perf.md "Batched movement ingest")
+echo "== ingest smoke =="
+JAX_PLATFORMS=cpu python scripts/ingest_smoke.py || fail=1
+
+# 10. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
 #    over every declared seam, bit-exact parity + zero stuck buckets
 #    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
 if [ "${GW_SOAK:-0}" = "1" ]; then
@@ -66,7 +73,7 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 10. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 11. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

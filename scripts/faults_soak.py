@@ -51,18 +51,43 @@ AOI_SEAM_KINDS = {
     "aoi.device": ["oom", "fail"],
 }
 
+# the batched ingest demotes on ANY kind (whole batch falls back to the
+# per-entity apply path, bit-identically); soaked at a pinned occurrence
+# inside the walk so the demotion provably fires every round
+INGEST_KINDS = ["oom", "fail", "stall", "poison"]
 
-def build_plan(seed: int) -> faults.FaultPlan:
+# under the one-tick deferral (cross_tick) only dispatch-side faults keep
+# per-tick delivery timing; harvest-side recovery (fetch/scalars/pages
+# regeneration, emit demotion mid-publish) CONVERGES instead of staying
+# tick-exact -- tests/test_cross_tick.py pins convergence for those, so
+# the cross-tick walks soak the timing-preserving menu and leave the
+# convergence contract to the dedicated test
+CROSS_TICK_SEAM_KINDS = {
+    "aoi.grow": ["oom", "fail"],
+    "aoi.h2d": ["oom", "fail", "stall"],
+    "aoi.delta": ["oom", "fail"],
+    "aoi.kernel": ["oom", "fail"],
+    "aoi.scalars": ["stall"],
+    "aoi.fetch": ["stall"],
+    "aoi.device": ["oom", "fail"],
+}
+
+
+def build_plan(seed: int, menu=None) -> faults.FaultPlan:
     rng = np.random.default_rng(seed)
     plan = faults.FaultPlan(seed=seed)
-    for seam, kinds in sorted(AOI_SEAM_KINDS.items()):
+    for seam, kinds in sorted((menu or AOI_SEAM_KINDS).items()):
         kind = kinds[int(rng.integers(len(kinds)))]
         arg = 0.001 if kind == "stall" else None
         plan.add(seam, kind, at="auto", arg=arg)
     return plan
 
 
-def soak_aoi(seed: int, cap=256, n=200, ticks=10) -> dict:
+def soak_aoi(seed: int, cap=256, n=200, ticks=10, cross_tick=False) -> dict:
+    """One engine walk under a full seam plan.  ``cross_tick=True`` runs
+    the paged bucket with the one-tick deferral on (the aoi_paged x
+    aoi_cross_tick combo): the oracle comparison shifts by one tick and a
+    trailing drain flush collects the last parked delivery."""
     rng = np.random.default_rng(seed)
     x = rng.uniform(0, 600, cap).astype(np.float32)
     z = rng.uniform(0, 600, cap).astype(np.float32)
@@ -72,11 +97,14 @@ def soak_aoi(seed: int, cap=256, n=200, ticks=10) -> dict:
 
     oracle = AOIEngine(default_backend="cpu")
     oh = oracle.create_space(cap)
-    plan = build_plan(seed)
+    plan = build_plan(seed,
+                      menu=CROSS_TICK_SEAM_KINDS if cross_tick else None)
     faults.install(plan)
     try:
-        eng = AOIEngine(default_backend="tpu", paged=True)
+        eng = AOIEngine(default_backend="tpu", paged=True,
+                        cross_tick=cross_tick)
         h = eng.create_space(cap)
+        ev, oev = [], []
         # ticks under fire, then the operator re-arm (demotion is sticky
         # by design) and two clean ticks proving the device path is back
         for t in range(ticks + 2):
@@ -92,8 +120,20 @@ def soak_aoi(seed: int, cap=256, n=200, ticks=10) -> dict:
             oracle.submit(oh, x, z, r, act)
             eng.flush()
             oracle.flush()
-            e, l = eng.take_events(h)
-            ce, cl = oracle.take_events(oh)
+            ev.append(eng.take_events(h))
+            oev.append(oracle.take_events(oh))
+        shift = 1 if cross_tick else 0
+        if shift:
+            # deferred cadence: tick 0 delivers nothing, one more flush
+            # drains the parked last tick
+            e0, l0 = ev[0]
+            assert len(e0) == 0 and len(l0) == 0, \
+                f"cross-tick tick 0 delivered seed={seed}"
+            eng.flush()
+            ev.append(eng.take_events(h))
+        for t in range(len(oev)):
+            e, l = ev[t + shift]
+            ce, cl = oev[t]
             np.testing.assert_array_equal(e, ce,
                                           err_msg=f"enter t={t} seed={seed}")
             np.testing.assert_array_equal(l, cl,
@@ -103,6 +143,90 @@ def soak_aoi(seed: int, cap=256, n=200, ticks=10) -> dict:
         return {"fired": len(plan.fired), "stats": st}
     finally:
         faults.clear()
+
+
+def soak_ingest(seed: int, n=48, ticks=8) -> dict:
+    """Runtime-level ingest soak on a paged cross-tick engine: the
+    batched wire->column decode walks under the timing-preserving
+    engine-seam plan PLUS an ``aoi.ingest`` spec pinned inside the walk
+    (so the batch demotion provably fires).  The drained sync stream
+    must be bit-identical to a clean per-entity decode of the same
+    wave."""
+    from goworld_tpu.engine.entity import Entity, GameClient
+    from goworld_tpu.engine.runtime import Runtime
+    from goworld_tpu.engine.space import Space
+    from goworld_tpu.engine.vector import Vector3
+    from goworld_tpu.ingest import (RECORD_SIZE, SYNC_RECORD,
+                                    MovementIngest, apply_per_entity)
+    from goworld_tpu.netutil.packet import Packet
+
+    class SoakScene(Space):
+        pass
+
+    class SoakWalker(Entity):
+        use_aoi = True
+        aoi_distance = 30.0
+
+    def run(batched, plan):
+        if plan is not None:
+            faults.install(plan)
+        try:
+            rt = Runtime(aoi_backend="tpu", aoi_paged=True,
+                         aoi_cross_tick=True, aoi_tpu_min_capacity=16)
+            rt.entities.register(SoakScene)
+            rt.entities.register(SoakWalker)
+            sc = rt.entities.create_space("SoakScene", kind=1)
+            sc.enable_aoi(30.0)
+            es, emap = [], {}
+            for i in range(n):
+                e = rt.entities.create(
+                    "SoakWalker", space=sc,
+                    pos=Vector3((i * 9.0) % 400, 0.0, (i * 7.0) % 400))
+                e.set_client_syncing(True)
+                e.set_client(GameClient(("s%05d" % i).ljust(16, "x")))
+                es.append(e)
+                emap[e.id] = i
+            rt.tick()
+            ing = MovementIngest(rt)
+            rng = np.random.default_rng(seed)
+            out = []
+            for _t in range(ticks):
+                xs = rng.uniform(0, 400, n).astype(np.float32)
+                zs = rng.uniform(0, 400, n).astype(np.float32)
+                yaws = rng.uniform(0, 6.28, n).astype(np.float32)
+                pkt = Packet(bytearray())
+                for j, e in enumerate(es):
+                    pkt.append_entity_id(e.id)
+                    pkt.append_f32(float(xs[j]))
+                    pkt.append_f32(0.0)
+                    pkt.append_f32(float(zs[j]))
+                    pkt.append_f32(float(yaws[j]))
+                if batched:
+                    ing.ingest(pkt)
+                else:
+                    apply_per_entity(rt.entities, np.frombuffer(
+                        pkt.read_view(n * RECORD_SIZE), dtype=SYNC_RECORD))
+                rt.tick()
+                out.append(sorted(
+                    (emap[eid], xx, yy, zz, yw)
+                    for _c, _g, eid, xx, yy, zz, yw in rt.drain_sync()))
+            return out, dict(ing.stats)
+        finally:
+            faults.clear()
+
+    clean, _ = run(batched=False, plan=None)
+    rng = np.random.default_rng(seed + 7)
+    plan = build_plan(seed, menu=CROSS_TICK_SEAM_KINDS)
+    kind = INGEST_KINDS[int(rng.integers(len(INGEST_KINDS)))]
+    plan.add("aoi.ingest", kind, at=int(rng.integers(2, ticks + 1)),
+             arg=0.001 if kind == "stall" else None)
+    faulted, st = run(batched=True, plan=plan)
+    assert faulted == clean, f"ingest sync stream diverged seed={seed}"
+    assert st["demoted_batches"] >= 1, \
+        f"pinned aoi.ingest spec never fired seed={seed}: {st}"
+    return {"kind": kind, "demoted": st["demoted_batches"],
+            "per_entity_writes": st["per_entity_writes"],
+            "batched": st["batched"]}
 
 
 class _Recorder:
@@ -177,15 +301,23 @@ def main(argv):
     base_seed = int(argv[2]) if len(argv) > 2 else 1000
     for i in range(rounds):
         seed = base_seed + i
-        a = soak_aoi(seed)
+        # alternate the engine walk's cadence so every soak covers both
+        # the sequential bucket and the aoi_paged x aoi_cross_tick combo
+        xt = bool(i % 2)
+        a = soak_aoi(seed, cross_tick=xt)
+        g = soak_ingest(seed)
         d = soak_dispatcher(seed)
-        print(f"round {i + 1}/{rounds} seed={seed}: "
+        print(f"round {i + 1}/{rounds} seed={seed}"
+              f"{' xtick' if xt else ''}: "
               f"aoi fired={a['fired']} rebuilds={a['stats']['rebuilds']} "
               f"host_ticks={a['stats']['host_ticks']} "
               f"page_spills={a['stats']['page_spills']} | "
+              f"ingest {g['kind']} demoted={g['demoted']} "
+              f"batched={g['batched']} | "
               f"disp fired={d['fired']} replayed={d['replayed']} -- "
               f"bit-exact, no stuck buckets")
-    print(f"faults_soak: OK ({rounds} rounds, all seams, parity held)")
+    print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.ingest, "
+          f"parity held)")
     return 0
 
 

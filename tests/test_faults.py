@@ -54,8 +54,9 @@ def test_seam_catalog_stable():
     """The catalog is API: docs, gwlint and env strings name these."""
     assert set(faults.SEAMS) == {
         "aoi.grow", "aoi.h2d", "aoi.delta", "aoi.kernel", "aoi.scalars",
-        "aoi.fetch", "aoi.emit", "aoi.device", "aoi.pages", "conn.send",
-        "conn.flush", "conn.recv", "disp.connect", "bench.config"}
+        "aoi.fetch", "aoi.emit", "aoi.device", "aoi.pages", "aoi.ingest",
+        "conn.send", "conn.flush", "conn.recv", "disp.connect",
+        "bench.config"}
     assert set(faults.KINDS) == {
         "oom", "fail", "stall", "poison", "reset", "partial"}
 

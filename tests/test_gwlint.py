@@ -387,6 +387,36 @@ def test_h2d_staging_covers_dispatch_helpers(tmp_path):
     }
 
 
+INGEST_H2D = """\
+    import jax.numpy as jnp
+    import numpy as np
+
+    def land(rec, cols, sl):
+        cols.x[sl] = rec["x"]            # host column write: fine
+        host = np.asarray(rec["z"])      # host-side numpy: fine
+        dev = jnp.asarray(cols.x)        # device upload: flagged
+        return mesh.device_put(host)     # flagged too
+
+    def stats(v):
+        ok = jnp.asarray(v)  # gwlint: allow[h2d-staging] -- fixture escape
+        return ok
+"""
+
+
+def test_h2d_staging_flags_any_upload_in_ingest(tmp_path):
+    """The ingest module is wire->column only: ANY device upload there --
+    any function, any argument -- bypasses the staging seam and is
+    flagged (the flush/dispatch scoping does not apply)."""
+    _mk(tmp_path, {"ingest/movement.py": INGEST_H2D})
+    findings, _ = _run(tmp_path, [h2d_staging.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("ingest/movement.py", _ln(INGEST_H2D, "jnp.asarray(cols.x)")),
+        ("ingest/movement.py", _ln(INGEST_H2D, "device_put(host)")),
+    }
+    assert all(f.rule == "h2d-staging" for f in findings)
+
+
 # -- flush-phase --------------------------------------------------------------
 
 DISPATCH = """\
