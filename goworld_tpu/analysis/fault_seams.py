@@ -34,6 +34,14 @@ from .core import Context, Finding
 
 RULE = "fault-seam-coverage"
 
+# seam families that only make sense complete: declaring or using any
+# member without the rest leaves part of the code path uninjectable --
+# e.g. a checkpoint journal whose writes can fault but whose restore
+# reads cannot is untestable durability
+FAMILIES = {
+    "store": ("store.write", "store.read", "store.manifest"),
+}
+
 
 def _declared_seams(sf) -> dict[str, int]:
     """SEAMS dict string keys -> declaration line, from faults.py."""
@@ -149,6 +157,29 @@ def check(ctx: Context):
             RULE, cat_sf.rel, line, 0,
             f"declared fault seam {seam!r} is checked nowhere in package "
             "code: dead catalog entry")
+
+    # family completeness: any member of a declared family present (in
+    # the catalog or at a check site) pulls in the whole family -- a
+    # journal whose write seam exists but whose read/manifest seams don't
+    # can only be fault-tested on half its durability path
+    for fam, members in sorted(FAMILIES.items()):
+        present = [m for m in members if m in declared or m in used]
+        if not present:
+            continue
+        missing = [m for m in members if m not in declared]
+        for m in missing:
+            anchor = next((mm for mm in members if mm in declared), None)
+            if anchor is not None:
+                path, line = cat_sf.rel, declared[anchor]
+            else:
+                path, line = used[present[0]]
+            yield Finding(
+                RULE, path, line, 0,
+                f"fault-seam family {fam!r} is incomplete: {m!r} is not "
+                f"declared in faults.SEAMS but "
+                f"{', '.join(sorted(present))} "
+                "exists -- the family must be declared, tested and "
+                "non-dead together")
 
     # bucket tiers that recover from device faults must also be
     # evacuable/migratable: the aoi.device failover path rebuilds every

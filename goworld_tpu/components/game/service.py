@@ -110,6 +110,30 @@ class GameService:
         self.kvdb = KVDBService(backend, post=self.rt.post.post)
         return self.kvdb
 
+    def attach_checkpoints(self, base_dir: str = "."):
+        """Arm durable world state (engine/checkpoint.py) when
+        ``aoi_checkpoint`` is non-off: the journal rides the configured
+        [storage] backend, the manifest the [kvdb] backend, both under
+        their own sub-directories so entity saves and checkpoints never
+        share a namespace.  Returns the controller (None when off)."""
+        if self.gcfg.aoi_checkpoint == "off":
+            return None
+        from ...kvdb import new_kvdb_backend
+        from ...kvdb.backends import config_kwargs as kv_kwargs
+        from ...storage import new_entity_storage
+        from ...storage.backends import config_kwargs as st_kwargs
+
+        ck_dir = os.path.join(base_dir, "checkpoints")
+        store = new_entity_storage(
+            self.cfg.storage.backend,
+            **st_kwargs(self.cfg.storage.backend, self.cfg.storage, ck_dir))
+        manifest = new_kvdb_backend(
+            self.cfg.kvdb.backend,
+            **kv_kwargs(self.cfg.kvdb.backend, self.cfg.kvdb, ck_dir))
+        return self.rt.arm_checkpoints(
+            store, manifest, mode=self.gcfg.aoi_checkpoint,
+            interval=self.gcfg.aoi_checkpoint_interval)
+
     # -- boot --------------------------------------------------------------
     def register_entity_type(self, cls, name=None):
         return self.rt.entities.register(cls, name)

@@ -64,6 +64,11 @@ class GameConfig:
     # double-buffer the tpu flush: AOI events arrive one tick late, device
     # and D2H time overlap the host tick (engine/aoi._TPUBucket docstring)
     aoi_pipeline: bool = False
+    # durable world state (engine/checkpoint.py): off | interval |
+    # continuous.  Non-off streams per-space incremental checkpoints into
+    # the [storage]/[kvdb] backends (GameService.attach_checkpoints)
+    aoi_checkpoint: str = "off"
+    aoi_checkpoint_interval: int = 16
     tick_interval_ms: int = consts.TICK_INTERVAL_MS
     position_sync_interval_ms: int = consts.POSITION_SYNC_INTERVAL_MS
     save_interval_s: int = consts.ENTITY_SAVE_INTERVAL_S

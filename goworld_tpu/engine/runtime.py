@@ -56,6 +56,11 @@ class Runtime:
         aoi_placement: str = "static",
         aoi_migration_threshold_ms: float = 5.0,
         aoi_migration_cooldown: int = 64,
+        aoi_checkpoint: str = "off",
+        aoi_checkpoint_interval: int = 16,
+        aoi_checkpoint_dir: str | None = None,
+        aoi_checkpoint_store=None,
+        aoi_checkpoint_kvdb=None,
         fault_plan: "faults.FaultPlan | str | None" = None,
         telemetry_on: bool = False,
     ):
@@ -90,6 +95,24 @@ class Runtime:
             self.aoi, mode=aoi_placement,
             threshold_ms=aoi_migration_threshold_ms,
             cooldown_ticks=aoi_migration_cooldown)
+        # durable world state (engine/checkpoint.py): "off" costs nothing;
+        # "interval"/"continuous" stream per-space incremental checkpoints
+        # off the hot path.  Backends come pre-built (aoi_checkpoint_store/
+        # _kvdb -- the GameService path, via storage/kvdb config) or are
+        # filesystem defaults under aoi_checkpoint_dir
+        self.checkpoint = None
+        if aoi_checkpoint != "off":
+            if aoi_checkpoint_store is None or aoi_checkpoint_kvdb is None:
+                if aoi_checkpoint_dir is None:
+                    raise ValueError(
+                        "aoi_checkpoint=%r needs aoi_checkpoint_dir or "
+                        "pre-built store+kvdb backends" % aoi_checkpoint)
+                from .checkpoint import _open_backends
+                aoi_checkpoint_store, aoi_checkpoint_kvdb = \
+                    _open_backends(aoi_checkpoint_dir)
+            self.arm_checkpoints(aoi_checkpoint_store, aoi_checkpoint_kvdb,
+                                 mode=aoi_checkpoint,
+                                 interval=aoi_checkpoint_interval)
         self.entities = EntityManager(self)
         self.tick_count = 0
         # entities with pending sync flags / attr deltas / quiet countdowns;
@@ -112,6 +135,18 @@ class Runtime:
         # set by GameService when clustered; entities reach cluster ops
         # (enter_space migration, remote calls) through it
         self.game = None
+
+    def arm_checkpoints(self, store, manifest, mode: str = "interval",
+                        interval: int = 16, **kw):
+        """Attach (or replace) the checkpoint controller post-construction
+        -- the GameService path, after storage/kvdb backends exist."""
+        from .checkpoint import CheckpointController
+
+        if self.checkpoint is not None:
+            self.checkpoint.close()
+        self.checkpoint = CheckpointController(
+            self.aoi, store, manifest, mode=mode, interval=interval, **kw)
+        return self.checkpoint
 
     def _default_on_error(self, e: BaseException):
         import traceback
@@ -136,6 +171,15 @@ class Runtime:
         # flush that just ran, and a migration started here snapshots
         # between ticks (no partially-staged state)
         self.placement.step()
+        # checkpoint capture AFTER placement: events for this tick are
+        # delivered, migrations are settled, so the export is snapshot-
+        # consistent; the expensive half runs on the background writer
+        if self.checkpoint is not None:
+            self.checkpoint.sync_tracked({
+                sid: sp._aoi_handle
+                for sid, sp in self.entities.spaces.items()
+                if sp._aoi_handle is not None})
+            self.checkpoint.step(self.tick_count)
         _TICK_SECONDS.observe(_trace.lap("tick", _t0))
 
     def _aoi_phase(self):

@@ -69,6 +69,26 @@ seam                      fires in
 ``conn.recv``             blocking packet read (netutil/conn.py recv)
 ``disp.connect``          dispatcher connect attempt (dispatchercluster)
 ``bench.config``          per-config bench run (bench.py main loop)
+``store.write``           checkpoint journal record write (engine/
+                          checkpoint.py background writer):
+                          ``fail``/``oom``/``reset`` = counted retry with
+                          capped backoff; retry budget exhausted = the
+                          epoch is dropped (counted) and the next capture
+                          is forced to a fresh base; ``partial``/
+                          ``poison`` = a torn/corrupt record lands on
+                          disk -- exactly what a mid-write SIGKILL
+                          leaves -- and the per-record CRC catches it at
+                          restore.  Never blocks the tick
+``store.read``            checkpoint journal record read at restore:
+                          ``fail``/``oom``/``reset`` = counted retry;
+                          ``partial``/``poison`` = torn/corrupt blob ->
+                          CRC mismatch -> the chain walk falls back to
+                          the last consistent epoch
+``store.manifest``        checkpoint manifest kvdb put/find: ``fail``/
+                          ``oom``/``reset`` = counted retry;
+                          ``partial``/``poison`` = unparseable manifest
+                          value, skipped at restore (the epoch reads as
+                          absent; an earlier consistent epoch wins)
 ========================  =====================================================
 
 Kinds: ``oom`` (raise :class:`DeviceOOM`), ``fail`` (raise
@@ -124,6 +144,17 @@ SEAMS = {
     "conn.recv": "blocking packet read",
     "disp.connect": "dispatcher connect attempt",
     "bench.config": "per-config bench run",
+    "store.write": "checkpoint journal record write (engine/checkpoint.py "
+                   "background writer; fail/oom/reset = counted retry with "
+                   "capped backoff, partial/poison = torn/corrupt record "
+                   "lands and the per-record CRC catches it at restore)",
+    "store.read": "checkpoint journal record read during restore (fail/oom/"
+                  "reset = counted retry; partial/poison = the read blob is "
+                  "torn/corrupt -> CRC mismatch -> fall back to the last "
+                  "consistent epoch)",
+    "store.manifest": "checkpoint manifest kvdb put/find (fail/oom/reset = "
+                      "counted retry; partial/poison = unparseable manifest "
+                      "entry, skipped at restore -> earlier epoch wins)",
 }
 
 

@@ -58,7 +58,24 @@ class FilesystemKVDB(KVDBBackend):
         self._log_records = 0
         self._replay()
         self._compact_if_worthwhile()
+        self._seal_torn_tail()
         self._log = open(self.path, "a", encoding="utf-8")
+
+    def _seal_torn_tail(self):
+        """A kill -9 mid-append can leave the log without a trailing
+        newline; appending straight after would glue the next record onto
+        the torn fragment and lose BOTH lines at the next replay.  Close
+        the tail with a newline so the fragment stays an isolated
+        discardable line."""
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except (FileNotFoundError, OSError):
+            return  # absent or empty log: nothing to seal
+        if torn:
+            with open(self.path, "ab") as f:
+                f.write(b"\n")
 
     def _replay(self):
         try:

@@ -62,7 +62,14 @@ JAX_PLATFORMS=cpu python scripts/migration_smoke.py || fail=1
 echo "== ingest smoke =="
 JAX_PLATFORMS=cpu python scripts/ingest_smoke.py || fail=1
 
-# 10. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+# 10. durable-state smoke (CPU backend: incremental checkpoint journal
+#    restores bit-exact in-process, then a real kill -9 -> restore ->
+#    replay run with per-tick CRC parity and events_lost=0 --
+#    docs/robustness.md "Durability & crash-restart")
+echo "== checkpoint smoke =="
+JAX_PLATFORMS=cpu python scripts/checkpoint_smoke.py || fail=1
+
+# 11. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
 #    over every declared seam, bit-exact parity + zero stuck buckets
 #    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
 if [ "${GW_SOAK:-0}" = "1" ]; then
@@ -73,7 +80,7 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 11. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 12. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -229,6 +229,104 @@ def soak_ingest(seed: int, n=48, ticks=8) -> dict:
             "batched": st["batched"]}
 
 
+# the durable-state seams (engine/checkpoint.py): every kind each guarded
+# op is built to absorb -- fail/oom/reset retry, stall rides the writer
+# thread, partial/poison land torn records the restore-side CRC catches
+STORE_SEAM_KINDS = {
+    "store.write": ["oom", "fail", "reset", "stall", "partial", "poison"],
+    "store.read": ["oom", "fail", "reset", "stall", "poison"],
+    "store.manifest": ["fail", "reset", "stall", "partial"],
+}
+
+
+def soak_checkpoint(seed: int, cap=128, ticks=8) -> dict:
+    """Checkpoint + restore under a randomized all-store-seam plan.  A
+    clean walk records each tick's exported state; the same walk then
+    runs with continuous checkpointing under fire, and the journal must
+    still restore to a bit-exact copy of SOME recorded tick (torn/
+    poisoned epochs legitimately shorten the chain -- the fallback tick
+    just moves earlier; a transient read fault may need the one re-arm
+    retry, the same operator story as the engine seams)."""
+    import shutil
+    import tempfile
+
+    from goworld_tpu.engine.aoi import _unpack_positions
+    from goworld_tpu.engine.checkpoint import (CheckpointController,
+                                               _open_backends)
+
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 400, cap).astype(np.float32)
+    z = rng.uniform(0, 400, cap).astype(np.float32)
+    r = np.full(cap, 15.0, np.float32)
+    act = np.ones(cap, bool)
+    frames = []
+    for _ in range(ticks):
+        x = x + rng.uniform(-3, 3, cap).astype(np.float32)
+        z = z + rng.uniform(-3, 3, cap).astype(np.float32)
+        frames.append((x.copy(), z.copy()))
+
+    plan = build_plan(seed, menu=STORE_SEAM_KINDS)
+    base = tempfile.mkdtemp(prefix="gw_soak_ckpt_")
+    eng = AOIEngine(default_backend="cpu")
+    h = eng._create_handle(cap, "tpu")
+    store, kv = _open_backends(base)
+    ctl = CheckpointController(eng, store, kv, mode="continuous",
+                               retry_base_s=0.0)
+    ctl.track("s", h)
+    by_tick = {}
+    n_events = 0
+    rest = None
+    faults.install(plan)
+    try:
+        for t, (fx, fz) in enumerate(frames, 1):
+            eng.submit(h, fx, fz, r, act)
+            eng.flush()
+            ev, lv = eng.take_events(h)
+            n_events += len(ev) + len(lv)
+            snap = h.bucket.export_snapshot(h.slot)
+            sx, sz = _unpack_positions(snap)
+            by_tick[t] = (sx, sz, snap["r"].copy(),
+                          np.asarray(snap["act"], bool).copy(),
+                          snap["words"].copy(), bool(snap["sub"]))
+            ctl.step(t)
+        assert ctl.drain(), f"ckpt writer stuck seed={seed}"
+        rest = CheckpointController(eng, store, kv, mode="off",
+                                    retry_base_s=0.0)
+        res = rest.restore("s")
+        if res is None:
+            # a read-side poison can tear every chain through the base;
+            # the operator re-arm (plan exhausted/cleared) + one retry
+            # must heal it -- the journal itself was never corrupt
+            faults.clear()
+            res = rest.restore("s")
+        assert res is not None, f"unrestorable journal seed={seed}"
+        snap, tick, epoch = res
+        assert tick in by_tick, f"restored unknown tick {tick} seed={seed}"
+        rx, rz = _unpack_positions(snap)
+        ex, ez, er, ea, ew, es = by_tick[tick]
+        np.testing.assert_array_equal(rx, ex, err_msg=f"x seed={seed}")
+        np.testing.assert_array_equal(rz, ez, err_msg=f"z seed={seed}")
+        np.testing.assert_array_equal(snap["r"], er, err_msg=f"r seed={seed}")
+        np.testing.assert_array_equal(np.asarray(snap["act"], bool), ea)
+        np.testing.assert_array_equal(snap["words"], ew)
+        assert bool(snap["sub"]) == es
+        assert n_events > 0, f"degenerate walk seed={seed}"
+        fired = sum(1 for f in plan.fired
+                    if f["seam"].startswith("store."))
+        return {"fired": fired, "restored_tick": tick, "epoch": epoch,
+                "dropped": ctl.stats["dropped_epochs"],
+                "torn": rest.stats["torn_records"]
+                + ctl.stats["torn_records"]}
+    finally:
+        faults.clear()
+        ctl.close(drain=False)
+        if rest is not None:
+            rest.close()
+        store.close()
+        kv.close()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 class _Recorder:
     """A dispatcher stand-in: records every framed payload it receives."""
 
@@ -306,6 +404,7 @@ def main(argv):
         xt = bool(i % 2)
         a = soak_aoi(seed, cross_tick=xt)
         g = soak_ingest(seed)
+        c = soak_checkpoint(seed)
         d = soak_dispatcher(seed)
         print(f"round {i + 1}/{rounds} seed={seed}"
               f"{' xtick' if xt else ''}: "
@@ -314,10 +413,12 @@ def main(argv):
               f"page_spills={a['stats']['page_spills']} | "
               f"ingest {g['kind']} demoted={g['demoted']} "
               f"batched={g['batched']} | "
+              f"ckpt fired={c['fired']} tick={c['restored_tick']} "
+              f"torn={c['torn']} | "
               f"disp fired={d['fired']} replayed={d['replayed']} -- "
               f"bit-exact, no stuck buckets")
-    print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.ingest, "
-          f"parity held)")
+    print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.ingest "
+          f"and store.*, parity held)")
     return 0
 
 

@@ -19,7 +19,7 @@ The contract under test (goworld_tpu/faults.py + docs/robustness.md):
 Seam coverage ledger (the fault-seam-coverage gwlint rule checks these
 literals): aoi.grow, aoi.h2d, aoi.delta, aoi.kernel, aoi.scalars,
 aoi.fetch, aoi.emit, conn.send, conn.flush, conn.recv, disp.connect,
-bench.config.
+bench.config, store.write, store.read, store.manifest.
 """
 
 import json
@@ -56,7 +56,7 @@ def test_seam_catalog_stable():
         "aoi.grow", "aoi.h2d", "aoi.delta", "aoi.kernel", "aoi.scalars",
         "aoi.fetch", "aoi.emit", "aoi.device", "aoi.pages", "aoi.ingest",
         "conn.send", "conn.flush", "conn.recv", "disp.connect",
-        "bench.config"}
+        "bench.config", "store.write", "store.read", "store.manifest"}
     assert set(faults.KINDS) == {
         "oom", "fail", "stall", "poison", "reset", "partial"}
 

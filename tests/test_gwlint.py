@@ -640,6 +640,81 @@ def test_fault_seam_coverage_requires_evacuation_hooks(tmp_path):
     assert findings[0].line == _ln(BUCKET_TIERS, "class _BadBucket")
 
 
+STORE_FAMILY_PARTIAL = """\
+    SEAMS = {
+        "store.write": "checkpoint journal write",
+    }
+"""
+
+STORE_FAMILY_FULL = """\
+    SEAMS = {
+        "store.write": "checkpoint journal write",
+        "store.read": "checkpoint journal read",
+        "store.manifest": "checkpoint manifest op",
+    }
+"""
+
+STORE_USER = """\
+    from . import faults
+
+    def writer():
+        faults.check("store.write")
+        faults.check("store.read")
+        faults.check("store.manifest")
+"""
+
+
+def test_fault_seam_family_incomplete_flagged(tmp_path):
+    """Declaring only store.write leaves the journal's read/restore half
+    uninjectable: the family rule demands all three members together."""
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py": STORE_FAMILY_PARTIAL,
+        "goworld_tpu/engine.py":
+            "from . import faults\n"
+            "def writer():\n"
+            '    faults.check("store.write")\n',
+        "tests/test_f.py": "assert 'store.write'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    fam = [f for f in findings if "family 'store' is incomplete" in f.message]
+    assert len(fam) == 2, [f.message for f in findings]
+    assert {("'store.read'" in f.message, "'store.manifest'" in f.message)
+            for f in fam} == {(True, False), (False, True)}
+    # anchored at the declared member's catalog line
+    assert all(f.path == "goworld_tpu/faults.py" for f in fam)
+    assert all(f.line == _ln(STORE_FAMILY_PARTIAL, '"store.write"')
+               for f in fam)
+
+
+def test_fault_seam_family_complete_clean(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py": STORE_FAMILY_FULL,
+        "goworld_tpu/engine.py": STORE_USER,
+        "tests/test_f.py":
+            "assert 'store.write' and 'store.read' and 'store.manifest'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_fault_seam_family_absent_family_ignored(tmp_path):
+    """A repo with no store.* member anywhere owes the family nothing."""
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py":
+            'SEAMS = {"aoi.kernel": "kernel launch"}\n',
+        "goworld_tpu/engine.py":
+            "from . import faults\n"
+            "def flush():\n"
+            '    faults.check("aoi.kernel")\n',
+        "tests/test_f.py": "assert 'aoi.kernel'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    assert findings == []
+
+
 # -- telemetry ---------------------------------------------------------------
 
 TELEM_USER = """\
