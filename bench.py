@@ -1866,6 +1866,147 @@ def bench_engine_ingest(cfg, n=2048, ticks=12, cross_tick=False):
     return out
 
 
+def bench_engine_interest(cfg, cap=512, ticks=13, period=4):
+    """Tiered-rate device-work A/B (docs/perf.md "Interest policies &
+    tiered rates"): the same composed team+tier+LOS walk through a
+    period=4 stack and a period=1 stack.  On every coinciding full-eval
+    boundary (t % 4 == 0) the two must produce bit-identical interest
+    words (equal folded CRC) while the period-4 side evaluates ~1/4 of
+    the line-of-sight samples -- the saving is recorded, the parity is
+    asserted.  A CPU-oracle twin of the period-4 stack pins
+    device/oracle stream parity in the same run."""
+    from goworld_tpu.interest import (DistanceField, LineOfSightPolicy,
+                                      PolicyStack, TeamVisibilityPolicy,
+                                      TieredRatePolicy)
+
+    def policies(k):
+        field = DistanceField.from_boxes(
+            [(20.0, 20.0, 45.0, 60.0), (-60.0, -10.0, -30.0, 10.0)],
+            (-100.0, -100.0), (200.0, 200.0), cell=5.0)
+        return [TeamVisibilityPolicy(), TieredRatePolicy(period=k),
+                LineOfSightPolicy(field, depth=2)]
+
+    rng = np.random.default_rng(23)
+    x = rng.uniform(-90.0, 90.0, cap).astype(np.float32)
+    z = rng.uniform(-90.0, 90.0, cap).astype(np.float32)
+    r = rng.uniform(10.0, 30.0, cap).astype(np.float32)
+    act = np.ones(cap, bool)
+    team = (np.uint32(1) << rng.integers(0, 4, cap)).astype(np.uint32)
+    vis = np.where(rng.random(cap) < 0.75, 0xFFFFFFFF, 0b1) \
+        .astype(np.uint32)
+    frames = []
+    for _ in range(ticks):
+        x = (x + rng.uniform(-4.0, 4.0, cap)).astype(np.float32)
+        z = (z + rng.uniform(-4.0, 4.0, cap)).astype(np.float32)
+        frames.append((x.copy(), z.copy(), r, act, team, vis))
+
+    def run(k, mode):
+        stack = PolicyStack(cap, policies(k), mode=mode)
+        walls, ev_crc, bnd_crc = [], 0, 0
+        for t, frame in enumerate(frames):
+            t0 = time.perf_counter()
+            stack.submit(*frame)
+            stack.step()
+            walls.append(time.perf_counter() - t0)
+            enter, leave = stack.take_events()
+            ev_crc = zlib.crc32(leave.tobytes(),
+                                zlib.crc32(enter.tobytes(), ev_crc))
+            if t % period == 0:  # both cadences just ran a full eval
+                bnd_crc = zlib.crc32(stack.words.tobytes(), bnd_crc)
+        return stack, walls, ev_crc, bnd_crc
+
+    k4, k4_walls, k4_ev, k4_bnd = run(period, "device")
+    k1, k1_walls, _k1_ev, k1_bnd = run(1, "device")
+    _orc, _o_walls, o_ev, _o_bnd = run(period, "host")
+    assert k4_bnd == k1_bnd, "tier boundary words diverged between cadences"
+    assert k4_ev == o_ev, "device stream diverged from the CPU oracle"
+    assert k4.stats["los_pair_evals"] < k1.stats["los_pair_evals"]
+
+    def _ms(walls):  # step 0 carries each cadence's jit compile
+        w = walls[1:] or walls
+        return round(sum(w) / len(w) * 1e3, 2)
+
+    saved = 1.0 - k4.stats["los_pair_evals"] / max(
+        k1.stats["los_pair_evals"], 1)
+    return {
+        "metric": "engine_interest",
+        "config": "engine_interest",
+        "kind": f"tiered-rate K={period} vs K=1 stack A/B (team+tier+LOS)",
+        "value": round(cap * (ticks - 1) / max(sum(k4_walls[1:]), 1e-9)),
+        "unit": "entity-steps/s",
+        "rate_kind": "device",
+        "detail": f"composed team+tier+LOS stack, {cap} entities, "
+                  f"{ticks} ticks; equal boundary-words CRC at 1/{period} "
+                  "of the LOS samples; CPU-oracle stream parity asserted",
+        "n_entities": cap,
+        "ticks": ticks,
+        "period": period,
+        "ms_per_tick": _ms(k4_walls),
+        "k1_ms_per_tick": _ms(k1_walls),
+        "parity_ok": True,
+        "parity_checksum": f"{k4_ev:08x}",
+        "boundary_words_crc": f"{k4_bnd:08x}",
+        "los_pair_evals": k4.stats["los_pair_evals"],
+        "k1_los_pair_evals": k1.stats["los_pair_evals"],
+        "los_pair_evals_saved_frac": round(saved, 3),
+        "full_evals": k4.stats["full_evals"],
+        "k1_full_evals": k1.stats["full_evals"],
+    }
+
+
+def bench_engine_load(cfg, n_clients=8192, n_spaces=8, period=4):
+    """Scripted-client load-harness row (docs/perf.md "Interest policies
+    & tiered rates"): vectorized clients through the gate-batch ->
+    columnar-ingest -> device interest-stack path, reporting per-tier
+    e2e latency percentiles NEXT TO moves/s (the tiered-rate latency
+    cost is reported, not hidden).  ``ticks = 2*period + 1`` ends on a
+    full-cadence step so every far-tier update closes inside the
+    window; a warmup run of exactly ``period`` ticks absorbs the stack
+    jit compile WITHOUT shifting the cadence (full evals fire at
+    ``step_count % period == 0``, so the measured window still ends on
+    one) -- the percentiles measure the steady state."""
+    from goworld_tpu.load import LoadHarness
+
+    ticks = 2 * period + 1
+    hz = LoadHarness(n_clients, n_spaces=n_spaces, n_gates=4,
+                     period=period, aoi_backend="cpu",
+                     interest_mode="device", seed=29)
+    hz.run(period)  # warmup: jit compile + the first full eval land here
+    report = hz.run(ticks)
+    ing = report["ingest"]
+    assert ing["per_entity_writes"] == 0, ing  # the bench criterion
+    assert report["unclosed"] == 0, report
+    tiers = report["tiers"]
+    out = {
+        "metric": "engine_load",
+        "config": "engine_load",
+        "kind": f"scripted-client load harness ({n_clients} clients, "
+                f"tiered interest period={period})",
+        "value": round(report["moves_per_s"]),
+        "unit": "moves/s",
+        "rate_kind": "e2e",
+        "detail": f"{n_clients} vectorized clients x {n_spaces} spaces, "
+                  f"{ticks} ticks; gate SYNC_RECORD batches -> columnar "
+                  "ingest -> device interest stacks; per-tier e2e latency",
+        "clients": n_clients,
+        "spaces": n_spaces,
+        "ticks": ticks,
+        "period": period,
+        "ms_per_tick": round(report["wall_s"] / ticks * 1e3, 2),
+        "ingest_batched_frac": 1.0,
+        "per_entity_writes": ing["per_entity_writes"],
+        "unclosed": report["unclosed"],
+        "interest_demotions": report["interest"]["demotions"],
+    }
+    for tier in ("near", "far"):
+        e = tiers[tier]
+        out[f"{tier}_n"] = e["n"]
+        if "p50_ms" in e:
+            out[f"{tier}_p50_ms"] = round(e["p50_ms"], 2)
+            out[f"{tier}_p99_ms"] = round(e["p99_ms"], 2)
+    return out
+
+
 def _ckpt_walk(cap, world, ticks, mode, interval=8, full_every=64, seed=17,
                movers_frac=1.0):
     """The _resilience_walk movement recipe with a CheckpointController
@@ -2299,6 +2440,13 @@ def main():
                 # the same A/B under the cross-tick scheduler (+xtick):
                 # both sides defer one tick, parity bar unchanged
                 emit(bench_engine_ingest(cfg, cross_tick=True))
+                # interest-policy tiered-rate A/B + the scripted-client
+                # load harness (docs/perf.md "Interest policies & tiered
+                # rates"), platform-agnostic like the rows above: equal
+                # boundary-words CRC at a fraction of the LOS samples,
+                # then per-tier e2e latency percentiles next to moves/s
+                emit(bench_engine_interest(cfg))
+                emit(bench_engine_load(cfg))
                 # durability benches (docs/robustness.md "Durability &
                 # crash-restart"), platform-agnostic like the rest:
                 # incremental-checkpoint overhead (<5% wall vs off,
@@ -2435,7 +2583,13 @@ def main():
                          ("moves_per_sec_after", "mps_post"),
                          ("parity_checksum", "crc"),
                          ("span_tick_ms", "span_ms"),
-                         ("host_other_ms", "host_ms")):
+                         ("host_other_ms", "host_ms"),
+                         ("clients", "clients"),
+                         ("near_p50_ms", "near_p50"),
+                         ("near_p99_ms", "near_p99"),
+                         ("far_p50_ms", "far_p50"),
+                         ("far_p99_ms", "far_p99"),
+                         ("los_pair_evals_saved_frac", "los_saved")):
             if src in o:
                 rec[dst] = o[src]
         print(json.dumps(rec), flush=True)

@@ -1,6 +1,6 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Ten checkers, each
+Run as ``python -m goworld_tpu.analysis <paths>``.  Eleven checkers, each
 an AST pass over the tree (stdlib-only -- no jax import needed):
 
 ===================  =====================================================
@@ -21,6 +21,8 @@ flush-phase          no host-sync call reachable from a bucket dispatch()
                      body (the split-phase scheduler's overlap contract)
 bounded-caps         cap-shaped device buffers carry a counted overflow
                      fallback (no silent fixed-cap truncation)
+oracle-parity        every registered InterestPolicy declares a CPU
+                     oracle and is referenced from tests/
 ===================  =====================================================
 
 See docs/static-analysis.md for the suppression story.
@@ -29,8 +31,8 @@ See docs/static-analysis.md for the suppression story.
 from __future__ import annotations
 
 from . import (bounded_caps, coverage, determinism, dtypes, fault_seams,
-               flush_phase, h2d_staging, host_sync, telemetry_rule,
-               wire_protocol)
+               flush_phase, h2d_staging, host_sync, oracle_parity,
+               telemetry_rule, wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
 CHECKERS = [
@@ -44,6 +46,7 @@ CHECKERS = [
     telemetry_rule.check,
     flush_phase.check,
     bounded_caps.check,
+    oracle_parity.check,
 ]
 
 __all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]

@@ -698,8 +698,17 @@ class AOIEngine:
                  tpu_min_capacity: int = 4096,
                  rowshard_min_capacity: int = 65536,
                  flush_sched: bool = True, emit: str = "auto",
-                 paged: bool = False, cross_tick: bool = False):
+                 paged: bool = False, cross_tick: bool = False,
+                 interest_mode: str = "device"):
         self.default_backend = default_backend
+        # interest-policy stacks (goworld_tpu/interest/): where attached
+        # stacks evaluate -- "device" = the fused jitted step, "host" =
+        # the CPU oracle (the bit-exact perf baseline bench_engine_interest
+        # A/Bs against).  Validated here, consumed by attach_interest.
+        if interest_mode not in ("device", "host"):
+            raise ValueError(
+                f"interest_mode must be device|host, got {interest_mode!r}")
+        self.interest_mode = interest_mode
         # cross-tick pipelining (docs/perf.md): tick T+1's dispatch (pack
         # + H2D + kernel enqueue on the double-buffered device state) runs
         # while tick T harvests -- the device bucket parks each dispatched
@@ -1032,6 +1041,18 @@ class AOIEngine:
                       if getattr(b, "_evacuating", False)]
         for key in sorted(evacuating):
             self._evacuate_bucket(key)
+        # interest-policy stacks evaluate LAST, after bucket harvest (and
+        # after any evacuation re-pointed their handles): each staged
+        # stack runs one fused step and accumulates its enter/leave diff
+        # for take_events.  Stacks are per-space independent, so the
+        # iteration order cannot affect results.
+        staged = [h for h in self._handles
+                  if getattr(h, "_policy_stack", None) is not None
+                  and h._policy_stack.has_pending]
+        if staged:
+            with _T.span("aoi.interest"):
+                for h in staged:
+                    h._policy_stack.step()
 
     # -- chip-loss failover (docs/robustness.md) --------------------------
 
@@ -1152,8 +1173,44 @@ class AOIEngine:
                           "cumulative migration/evacuation wall time (ms)"))
         return out
 
+    def attach_interest(self, h: SpaceAOIHandle, policies,
+                        mode: str | None = None):
+        """Attach a composable interest-policy stack to a space
+        (goworld_tpu/interest/): from here on the stack's fused step --
+        radius AND team mask AND tier cadence AND line of sight -- owns
+        the space's event stream (:meth:`take_events` returns the
+        stack's diff), while the base bucket keeps carrying the radius
+        state through migration/checkpoint/growth untouched.  A restore
+        snapshot stashed on the handle (``_interest_snapshot``, set by
+        checkpoint.restore_into) is imported automatically so policy
+        state rides the pad_packet payload format end to end."""
+        from ..interest import PolicyStack
+
+        if getattr(h, "_policy_stack", None) is not None:
+            raise ValueError("space already has an interest stack")
+        stack = PolicyStack(h.capacity, policies,
+                            mode=mode or self.interest_mode)
+        snap = getattr(h, "_interest_snapshot", None)
+        if snap is not None:
+            stack.import_payload(snap)
+            h._interest_snapshot = None
+        h._policy_stack = stack
+        return stack
+
+    @staticmethod
+    def interest_stack(h: SpaceAOIHandle):
+        """The space's PolicyStack, or None (plain radius-only space)."""
+        return getattr(h, "_policy_stack", None)
+
     def take_events(self, h: SpaceAOIHandle):
         """(enter_pairs, leave_pairs) for this space from the last flush."""
+        stack = getattr(h, "_policy_stack", None)
+        if stack is not None:
+            # the stack owns the stream: drop the bucket's base-predicate
+            # diff (the bucket still computes/carries base state -- that
+            # is what migration double-cover and checkpoints verify)
+            h.bucket.take_events(h.slot)
+            return stack.take_events()
         return h.bucket.take_events(h.slot)
 
     def set_subscribed(self, h: SpaceAOIHandle, flag: bool) -> None:
@@ -1176,6 +1233,9 @@ class AOIEngine:
         if mig is not None:  # keep the double-cover target in lockstep
             mig.t.bucket.clear_entity(mig.t.slot, entity_slot)
         h.bucket.clear_entity(h.slot, entity_slot)
+        stack = getattr(h, "_policy_stack", None)
+        if stack is not None:
+            stack.clear_entity(entity_slot)
 
     def grow_space(self, h: SpaceAOIHandle, new_capacity: int) -> SpaceAOIHandle:
         """Move a space to a larger-capacity bucket, carrying its interest
@@ -1220,6 +1280,13 @@ class AOIEngine:
         pending = h.bucket._events.pop(h.slot, None)
         if pending is not None:
             nh.bucket._events[nh.slot] = pending
+        stack = getattr(h, "_policy_stack", None)
+        if stack is not None:
+            # the interest stack grows with the space: same planar column
+            # remap as the base carry above, then it rides the NEW handle
+            stack.grow(new_capacity)
+            nh._policy_stack = stack
+            h._policy_stack = None
         self.release_space(h)
         return nh
 

@@ -282,6 +282,13 @@ class CheckpointController:
                         "epoch": sh.epoch, "tick": tick,
                         "capacity": int(snap["capacity"]),
                         "sub": bool(snap["sub"])})
+        stack = getattr(h, "_policy_stack", None)
+        if stack is not None:
+            # interest-policy state rides EVERY record (base and delta) as
+            # a self-contained blob in the pad_packet snapshot format:
+            # last-wins at fold time, so the chain walk needs no
+            # stack-specific delta logic
+            payload["interest"] = stack.export_payload()
         try:
             self._q.put_nowait((space_id, sh.epoch, tick, kind, payload))
         except queue.Full:
@@ -607,6 +614,7 @@ class CheckpointController:
             .reshape(cap, wcols).copy()
         sub = bool(base["sub"])
         tick = int(base["tick"])
+        interest = base.get("interest")
         for ent, d in chain[1:]:
             _apply_pos_packet(d.get("pos"), x, z)
             if "r_idx" in d:
@@ -621,7 +629,12 @@ class CheckpointController:
                     np.frombuffer(pb, np.uint32).reshape(-1, wcols)
             sub = bool(d["sub"])
             tick = int(d["tick"])
-        return _build_snapshot(cap, x, z, r, act, sub, words), tick
+            if "interest" in d:
+                interest = d["interest"]
+        snap = _build_snapshot(cap, x, z, r, act, sub, words)
+        if interest is not None:
+            snap["interest"] = interest
+        return snap, tick
 
     def restore_into(self, engine, space_id: str, tier: str | None = None,
                      backend: str | None = None):
@@ -640,6 +653,10 @@ class CheckpointController:
         else:
             h = engine.create_space(snap["capacity"], backend)
         h.bucket.import_snapshot(h.slot, snap)
+        if "interest" in snap:
+            # stash for attach_interest: the restoring space re-declares
+            # its policies (code), the payload restores their state
+            h._interest_snapshot = snap["interest"]
         sh = _SpaceShadow(h)
         sh.epoch = epoch + 1
         sh.enqueued_tick = sh.acked_tick = tick

@@ -44,8 +44,10 @@ import numpy as np
 from .vector import Vector3
 
 # columns staged to the device every tick (the delta-staging shadow set;
-# engine/aoi._TPUBucket._hx/_hz/_hr/_hact/_hsub)
-HOT_DEVICE_COLUMNS = ("x", "z", "r", "act", "nonplain")
+# engine/aoi._TPUBucket._hx/_hz/_hr/_hact/_hsub).  team/vis feed the
+# interest-policy stack's fused step (goworld_tpu/interest/) on spaces
+# with a team_mask policy: observer A sees B iff vis[A] & team[B] != 0
+HOT_DEVICE_COLUMNS = ("x", "z", "r", "act", "nonplain", "team", "vis")
 # host-only companions enabling fully vectorized ingest + sync flagging
 HOST_COLUMNS = ("y", "yaw", "sync", "watched")
 
@@ -54,7 +56,7 @@ class ColumnStore:
     """Per-space columnar arrays, grown by doubling (never shrunk: slot
     indices are stable for the space's lifetime)."""
 
-    __slots__ = ("cap", "x", "z", "r", "act", "nonplain",
+    __slots__ = ("cap", "x", "z", "r", "act", "nonplain", "team", "vis",
                  "y", "yaw", "sync", "watched")
 
     def __init__(self):
@@ -64,6 +66,8 @@ class ColumnStore:
         self.r = np.empty(0, np.float32)
         self.act = np.empty(0, bool)
         self.nonplain = np.zeros(0, bool)
+        self.team = np.zeros(0, np.uint32)
+        self.vis = np.zeros(0, np.uint32)
         self.y = np.empty(0, np.float32)
         self.yaw = np.empty(0, np.float32)
         self.sync = np.zeros(0, np.uint8)
@@ -78,6 +82,7 @@ class ColumnStore:
             grown[: len(arr)] = arr
             setattr(self, name, grown)
         for name, dt in (("act", bool), ("nonplain", bool),
+                         ("team", np.uint32), ("vis", np.uint32),
                          ("sync", np.uint8), ("watched", bool)):
             arr = getattr(self, name)
             grown = np.zeros(new_cap, dt)
@@ -90,6 +95,8 @@ class ColumnStore:
         that gates behavior must not leak to the next occupant)."""
         self.act[slot] = False
         self.nonplain[slot] = False
+        self.team[slot] = 0
+        self.vis[slot] = 0
         self.sync[slot] = 0
         self.watched[slot] = False
 

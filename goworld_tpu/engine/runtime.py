@@ -53,6 +53,7 @@ class Runtime:
         aoi_emit: str = "auto",
         aoi_paged: bool = False,
         aoi_cross_tick: bool = False,
+        aoi_interest: str = "device",
         aoi_placement: str = "static",
         aoi_migration_threshold_ms: float = 5.0,
         aoi_migration_cooldown: int = 64,
@@ -86,7 +87,8 @@ class Runtime:
                              tpu_min_capacity=aoi_tpu_min_capacity,
                              rowshard_min_capacity=aoi_rowshard_min_capacity,
                              flush_sched=aoi_flush_sched, emit=aoi_emit,
-                             paged=aoi_paged, cross_tick=aoi_cross_tick)
+                             paged=aoi_paged, cross_tick=aoi_cross_tick,
+                             interest_mode=aoi_interest)
         # telemetry-driven placement (engine/placement.py): "static" keeps
         # spaces where capacity routing put them (migrate() stays available
         # as the operator entry point); "auto" re-homes hot/idle spaces

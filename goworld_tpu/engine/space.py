@@ -131,6 +131,42 @@ class Space(Entity):
     def aoi_enabled(self) -> bool:
         return self._aoi_handle is not None
 
+    def enable_interest(self, *policies, mode: str | None = None):
+        """Attach a composable interest-policy stack to this space
+        (goworld_tpu/interest/): team/faction visibility, tiered update
+        rates, line-of-sight occlusion -- fused into one device pass and
+        composed with the base radius predicate.  Requires ``enable_aoi``
+        first; like it, must run before entities enter (the stack's
+        previous-step state starts empty).  Returns the PolicyStack."""
+        if self._aoi_handle is None:
+            raise RuntimeError("enable_aoi before enable_interest")
+        if self.entities:
+            raise RuntimeError(
+                "enable interest policies before entities enter the space")
+        return self._runtime().aoi.attach_interest(
+            self._aoi_handle, policies, mode=mode)
+
+    @property
+    def interest_stack(self):
+        """The attached PolicyStack, or None (radius-only space)."""
+        h = self._aoi_handle
+        return None if h is None else getattr(h, "_policy_stack", None)
+
+    def set_aoi_team(self, e: Entity, team: int, vis: int | None = None):
+        """Set an entity's faction columns (team_mask policy semantics:
+        observer A sees B iff ``vis[A] & team[B] != 0``).  ``team`` is
+        B-side (what bitmask the entity presents), ``vis`` is A-side
+        (which team bits the entity can see); ``vis=None`` keeps the
+        current visibility mask.  Entities enter with team=1,
+        vis=0xFFFFFFFF -- mutually visible until told otherwise."""
+        if e.space is not self or e.aoi_slot < 0:
+            raise ValueError(f"{e} holds no AOI slot in this space")
+        cols = self._cols
+        cols.team[e.aoi_slot] = np.uint32(team)
+        if vis is not None:
+            cols.vis[e.aoi_slot] = np.uint32(vis)
+        self._aoi_dirty = True
+
     def _ensure_capacity(self, n: int):
         if n <= self._cap:
             return
@@ -184,6 +220,11 @@ class Space(Entity):
                 e.aoi_distance if e.aoi_distance > 0 else self._aoi_default_dist
             )
             cols.act[slot] = True
+            # faction defaults: on one team, sees everyone -- a space with
+            # a team_mask policy behaves exactly radius-like until
+            # set_aoi_team says otherwise
+            cols.team[slot] = np.uint32(1)
+            cols.vis[slot] = np.uint32(0xFFFFFFFF)
             cols.sync[slot] = 0
             cols.watched[slot] = (e._watcher_clients > 0
                                   or e.client is not None)
@@ -310,12 +351,17 @@ class Space(Entity):
         if self._aoi_handle is None or not self._aoi_dirty:
             return False
         aoi = self._runtime().aoi
+        stack = getattr(self._aoi_handle, "_policy_stack", None)
         # subscription tracks "does anyone consume events?": pairs whose
         # observer is plain are dropped at delivery anyway, so an all-plain
         # space needs no event stream at all -- the calculator skips its
-        # extraction/fetch/decode and interest state derives on demand
+        # extraction/fetch/decode and interest state derives on demand.
+        # With an interest stack attached the BUCKET's stream is never
+        # consumed at all (the stack owns take_events), so the bucket
+        # unsubscribes outright while still carrying the base state.
         cols = self._cols
-        sub = bool(cols.nonplain[: self._slot_watermark].any())
+        sub = (stack is None
+               and bool(cols.nonplain[: self._slot_watermark].any()))
         if sub != self._aoi_subscribed:
             self._aoi_subscribed = sub
             aoi.set_subscribed(self._aoi_handle, sub)
@@ -324,6 +370,9 @@ class Space(Entity):
         # shadows -- wire/logic writes land here vectorized and nothing
         # walks entities between a move and the H2D packet
         aoi.submit(self._aoi_handle, cols.x, cols.z, cols.r, cols.act)
+        if stack is not None:
+            stack.submit(cols.x, cols.z, cols.r, cols.act,
+                         cols.team, cols.vis)
         self._aoi_dirty = False
         return True
 
@@ -395,16 +444,22 @@ class Space(Entity):
         h = self._aoi_handle
         if h is None or slot < 0:
             return []
-        derive = getattr(h.bucket, "derive_row", None)
-        if derive is not None:
-            # row-sharded oversized space: fetch ONE observer's words [W]
-            # (16 KB) instead of materializing the full [C, W] state
-            row = derive(h.slot, slot)
+        stack = getattr(h, "_policy_stack", None)
+        if stack is not None:
+            # policy space: the stack's post-step words ARE the interest
+            # state (the bucket's base words ignore team/tier/los)
+            row = stack.words[slot]
         else:
-            words = h.bucket.peek_words(h.slot)
-            if words is None:
-                words = h.bucket.get_prev(h.slot)
-            row = words[slot]
+            derive = getattr(h.bucket, "derive_row", None)
+            if derive is not None:
+                # row-sharded oversized space: fetch ONE observer's words
+                # [W] (16 KB) instead of materializing the full [C, W]
+                row = derive(h.slot, slot)
+            else:
+                words = h.bucket.peek_words(h.slot)
+                if words is None:
+                    words = h.bucket.get_prev(h.slot)
+                row = words[slot]
         w_per = row.shape[0]
         sn = self._slot_np
         out = []
@@ -423,13 +478,17 @@ class Space(Entity):
         h = self._aoi_handle
         if h is None or slot < 0:
             return []
+        stack = getattr(h, "_policy_stack", None)
         derive = getattr(h.bucket, "derive_col", None)
-        if derive is not None:
+        if stack is None and derive is not None:
             rows = derive(h.slot, slot)
         else:
-            words = h.bucket.peek_words(h.slot)
-            if words is None:
-                words = h.bucket.get_prev(h.slot)
+            if stack is not None:
+                words = stack.words
+            else:
+                words = h.bucket.peek_words(h.slot)
+                if words is None:
+                    words = h.bucket.get_prev(h.slot)
             from ..ops import aoi_predicate as AP
 
             w, b = AP.word_bit_for_column(slot, self._cap)

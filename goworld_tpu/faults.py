@@ -53,6 +53,16 @@ seam                      fires in
                           whole batch to the per-entity apply path --
                           bit-identical semantics, counted in the
                           ingest fallback stats
+``aoi.interest``          interest-policy stack evaluation (goworld_tpu/
+                          interest/): poisoned mask, stale tier state,
+                          corrupt distance field -- ANY fired kind
+                          demotes the space's stack STICKY to the
+                          radius-only oracle path (the one filter no
+                          corrupt policy state can reach), counted in
+                          ``interest.demotions``; the operator re-arm is
+                          ``PolicyStack.reset_interest`` (next step is a
+                          forced full eval whose diff re-emits the
+                          policy transitions deterministically)
 ``aoi.pages``             paged-storage allocator at harvest (paged
                           buckets, docs/perf.md): ``oom``/``fail``/
                           ``partial`` = pool exhaustion -- the bucket
@@ -139,6 +149,10 @@ SEAMS = {
                  "table corruption caught by validation -> shadow rebuild)",
     "aoi.ingest": "batched wire->column movement decode (any kind demotes "
                   "the batch to the per-entity apply path, bit-identical)",
+    "aoi.interest": "interest-policy stack evaluation (any kind = poisoned "
+                    "mask / stale tier / corrupt distance field -> sticky "
+                    "demotion to the radius-only oracle path, counted; "
+                    "PolicyStack.reset_interest re-arms)",
     "conn.send": "typed packet send",
     "conn.flush": "framed batch write",
     "conn.recv": "blocking packet read",

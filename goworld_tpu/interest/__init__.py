@@ -1,0 +1,39 @@
+"""Composable per-space interest policies (the AOI policy subsystem).
+
+The base AOI engine answers ONE question -- "who is inside my radius?"
+-- with bit-exact device/oracle parity.  This package generalizes that
+seam: a per-space stack of registered :class:`InterestPolicy` filters
+(team/faction visibility, tiered update rates, line-of-sight occlusion)
+fused into a single jitted device pass, each policy with its own CPU
+oracle and the whole composition bit-exact against
+:mod:`goworld_tpu.interest.oracle`.
+
+Entry points:
+
+* ``Space.enable_interest(*policies)`` -- attach a stack to a space
+  (after ``enable_aoi``, before entities enter);
+* ``Space.set_aoi_team(entity, team, vis)`` -- the faction columns;
+* ``AOIEngine.attach_interest`` / ``PolicyStack`` -- the engine-level
+  seam (what migration, growth and checkpoint integrate with);
+* ``DistanceField.from_boxes`` -- bake static geometry for LOS.
+
+See docs/perf.md ("Interest policies & tiered rates") and
+docs/tpu-aoi-design.md for the device-pass architecture.
+"""
+
+from .field import DistanceField
+from .policy import (POLICIES, InterestPolicy, LineOfSightPolicy,
+                     PolicyStack, StackConfig, TeamVisibilityPolicy,
+                     TieredRatePolicy, register)
+
+__all__ = [
+    "DistanceField",
+    "InterestPolicy",
+    "LineOfSightPolicy",
+    "POLICIES",
+    "PolicyStack",
+    "StackConfig",
+    "TeamVisibilityPolicy",
+    "TieredRatePolicy",
+    "register",
+]

@@ -1,0 +1,55 @@
+"""The CPU oracle for interest-policy stacks.
+
+Every registered :class:`~goworld_tpu.interest.policy.InterestPolicy`
+declares a CPU oracle (enforced by the ``oracle-parity`` gwlint rule);
+this module is the stack-level composition of those oracles: a plain
+numpy evaluation of the SAME expression tree the fused device step runs
+(ops/interest_kernels.py is the single source of truth; this module just
+binds ``xp=numpy``).  It is:
+
+* the bit-exactness reference every device evaluation is compared
+  against (tests/test_interest.py, scripts/interest_smoke.py);
+* the per-step fallback when the device evaluation faults
+  (``host_steps`` in the stack stats -- same semantics, host arithmetic);
+* the whole evaluation path in ``interest_mode="host"`` engines (the
+  perf A/B baseline bench_engine_interest runs against).
+
+The DEMOTED path (``aoi.interest`` seam fired: poisoned mask, stale
+tier, corrupt distance field) is deliberately NOT the full oracle: it is
+the radius-only predicate below -- the one filter that needs no policy
+state at all, so no corrupt input can reach it.  Demotion is sticky and
+counted; ``reset_interest`` re-arms (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import interest_kernels as K
+
+
+def eval_step(x, z, r, act, team, vis, prev_final_words, prev_near_words,
+              cfg, full: bool, grid=None):
+    """One stack evaluation on the host: returns packed
+    (final_words, near_words), each uint32 [C, W] -- bit-exact with
+    interest/device.py's jitted step on the same inputs."""
+    c = x.shape[0]
+    prev_final = K.unpack_words(prev_final_words, c, np)
+    prev_near = K.unpack_words(prev_near_words, c, np)
+    final, near = K.step_masks(
+        np.asarray(x, np.float32), np.asarray(z, np.float32),
+        np.asarray(r, np.float32), np.asarray(act, bool),
+        np.asarray(team, np.uint32), np.asarray(vis, np.uint32),
+        prev_final, prev_near, cfg, full, np, grid=grid)
+    return K.pack_bool(final, np), K.pack_bool(near, np)
+
+
+def eval_radius_only(x, z, r, act):
+    """The demotion target: base predicate only (no team, no tier, no
+    line of sight) -- packed words [C, W].  Matches the engine's
+    recovery-path predicate (engine/aoi._packed_predicate semantics)."""
+    gate = K.pair_gate(np.asarray(act, bool), np)
+    final = K.base_mask(np.asarray(x, np.float32),
+                        np.asarray(z, np.float32),
+                        np.asarray(r, np.float32), gate, np)
+    return K.pack_bool(final, np)

@@ -1,0 +1,20 @@
+"""Scripted-client load harness (the million-client half of the
+interest subsystem PR).
+
+``LoadHarness`` binds a vectorized scripted fleet (clients.py) to an
+in-process world and drives gate-shaped sync batches through the batched
+ingest front door every tick, reporting client-observed e2e latency
+percentiles PER INTEREST TIER next to raw moves/s.  Entry points:
+
+* ``scripts/loadgen_smoke.py`` -- the CI-smoke configuration (10^5
+  clients, scale-down ticks; ``GW_LOADGEN_N`` overrides);
+* ``bench.py engine_load`` -- the bench-suite rows (engine_load
+  metrics, recap p50/p99 columns);
+* ``LoadHarness(...)`` directly for custom scales.
+"""
+
+from .clients import GateBatcher, ScriptedFleet
+from .harness import LoadHarness, LoadScene, LoadWalker
+
+__all__ = ["GateBatcher", "LoadHarness", "LoadScene", "LoadWalker",
+           "ScriptedFleet"]

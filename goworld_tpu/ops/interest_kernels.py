@@ -1,0 +1,203 @@
+"""Interest-policy predicates: the single source of truth for the
+composable per-space filters (goworld_tpu/interest/).
+
+Like :mod:`ops.aoi_predicate` for the base radius predicate, every policy
+mask is defined ONCE here and evaluated by both halves of the subsystem:
+
+* the CPU oracle (interest/oracle.py) calls these with ``xp=numpy``;
+* the fused device step (interest/device.py) calls them with
+  ``xp=jax.numpy`` inside one jitted function.
+
+Bit-exact enter/leave parity between the two is only possible if both
+evaluate the *same* expression tree with the *same* rounding, so every
+float op here is IEEE-754 exactly rounded in float32 on every backend:
+
+* the base predicate reuses the aoi_predicate discipline (sub, abs,
+  compare -- no squared distances);
+* the tier thresholds are SINGLE multiplies (``r * near_frac`` and then
+  ``rn * hysteresis``): one exactly-rounded f32 mul each, never a
+  mul-add chain XLA could contract into an FMA;
+* line-of-sight sample points are **dyadic midpoints**: each point is a
+  chain of ``(a + b) * 0.5`` steps.  The halving multiply is exact and
+  the add-then-mul shape has no FMA pattern to contract, so numpy and
+  XLA produce bit-identical sample positions -- the naive
+  ``a + (b - a) * t`` parameterization does NOT survive XLA's mul-add
+  contraction (measured: ``floor((p - origin) * inv)`` diverges).
+
+The distance-field grid itself is precomputed host-side (interest/
+field.py) and shared verbatim by both backends; only the sampling below
+must be -- and is -- replay-exact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+F32_HALF = np.float32(0.5)
+F32_ZERO = np.float32(0.0)
+U32_ONE = np.uint32(1)
+WORD_BITS = 32
+
+
+# -- packed word layout (planar; see ops/aoi_predicate.py) ------------------
+
+def pack_bool(m, xp):
+    """bool [C, C] -> uint32 words [C, W] (planar layout), generic over
+    numpy/jnp.  Integer shifts and sums are exact on every backend."""
+    c = m.shape[1]
+    w = c // WORD_BITS
+    planes = m.reshape(m.shape[0], WORD_BITS, w).astype(xp.uint32)
+    shifts = xp.arange(WORD_BITS, dtype=xp.uint32)[None, :, None]
+    return xp.sum(planes << shifts, axis=1, dtype=xp.uint32)
+
+
+def unpack_words(words, capacity: int, xp):
+    """uint32 [C, W] -> bool [C, capacity] (inverse of pack_bool)."""
+    shifts = xp.arange(WORD_BITS, dtype=xp.uint32)[None, :, None]
+    planes = (words[:, None, :] >> shifts) & xp.uint32(1)
+    return planes.reshape(words.shape[0], capacity).astype(bool)
+
+
+# -- the policy masks -------------------------------------------------------
+
+def pair_gate(act, xp):
+    """active(A) & active(B) & A != B -- the gate every mask composes
+    with (bool [C, C])."""
+    c = act.shape[0]
+    eye = xp.eye(c, dtype=bool)
+    return (act[:, None] & act[None, :]) & ~eye
+
+
+def base_mask(x, z, r, gate, xp):
+    """The radius predicate (Chebyshev window, per-observer radius) --
+    identical to ops/aoi_predicate.interest_matrix, composed with an
+    externally supplied ``gate`` (pair_gate, possibly AND-ed with policy
+    masks already)."""
+    dx = xp.abs(x[None, :] - x[:, None])  # f32, exactly rounded
+    dz = xp.abs(z[None, :] - z[:, None])
+    rr = r[:, None]
+    return (dx <= rr) & (dz <= rr) & gate
+
+
+def chebyshev(x, z, xp):
+    """Pairwise Chebyshev distance [C, C] (max of exact f32 |deltas|)."""
+    dx = xp.abs(x[None, :] - x[:, None])
+    dz = xp.abs(z[None, :] - z[:, None])
+    return xp.maximum(dx, dz)
+
+
+def team_mask(team, vis, xp):
+    """Faction visibility: observer A sees B iff A's visibility mask has
+    any bit of B's team bitmask set (uint32 columns in the ECS store --
+    pure integer ops, trivially exact)."""
+    return (vis[:, None] & team[None, :]) != 0
+
+
+def near_mask(d, r, prev_near, gate, near_frac, hysteresis, xp):
+    """Tier assignment with bit-exact hysteresis (device-computed).
+
+    A pair becomes NEAR when d <= r*near_frac and stays near until
+    d > (r*near_frac)*hysteresis -- two single f32 multiplies (each
+    exactly rounded; verified bit-identical numpy vs XLA-CPU), so the
+    tier words never flap at a threshold and never diverge between the
+    oracle and the device step."""
+    rn = r * near_frac
+    rf = rn * hysteresis
+    near = (d <= rn[:, None]) | (prev_near & (d <= rf[:, None]))
+    return near & gate
+
+
+def segment_midpoints(ax, az, bx, bz, depth: int, xp):
+    """The dyadic sample points of segment A->B, in order along the
+    segment: depth 1 -> 1 point (t=1/2), depth 2 -> 3 (1/4, 1/2, 3/4),
+    depth d -> 2^d - 1.  Every point is a chain of exact
+    ``(a + b) * 0.5`` halvings -- the bit-exactness workhorse (module
+    docstring)."""
+    out = []
+
+    def rec(ax, az, bx, bz, d):
+        mx = (ax + bx) * F32_HALF
+        mz = (az + bz) * F32_HALF
+        if d > 1:
+            rec(ax, az, mx, mz, d - 1)
+        out.append((mx, mz))
+        if d > 1:
+            rec(mx, mz, bx, bz, d - 1)
+
+    rec(ax, az, bx, bz, depth)
+    return out
+
+
+def los_clear(x, z, grid, origin_x, origin_z, inv_cell, depth: int, xp):
+    """Line-of-sight mask [C, C]: True when NO sampled point of the
+    A->B segment lands in an occluded cell of the precomputed distance
+    field (grid value <= 0 means inside an obstacle).
+
+    Sample cells come from ``floor((p - origin) * inv_cell)``: one exact
+    f32 sub, one single mul (no FMA shape), exact floor; the clip runs
+    in f32 BEFORE the int cast so an out-of-world coordinate can never
+    hit the undefined float->int overflow (where numpy and XLA differ).
+    """
+    nz_cells, nx_cells = grid.shape
+    xmax = np.float32(nx_cells - 1)
+    zmax = np.float32(nz_cells - 1)
+    ax, az = x[:, None], z[:, None]
+    bx, bz = x[None, :], z[None, :]
+    blocked = None
+    for px, pz in segment_midpoints(ax, az, bx, bz, depth, xp):
+        fx = xp.clip(xp.floor((px - origin_x) * inv_cell), F32_ZERO, xmax)
+        fz = xp.clip(xp.floor((pz - origin_z) * inv_cell), F32_ZERO, zmax)
+        hit = grid[fz.astype(xp.int32), fx.astype(xp.int32)] <= F32_ZERO
+        blocked = hit if blocked is None else (blocked | hit)
+    return ~blocked
+
+
+# -- the composed per-tick step ---------------------------------------------
+
+def step_masks(x, z, r, act, team, vis, prev_final, prev_near, cfg, full,
+               xp, grid=None):
+    """One policy-stack evaluation: (final_mask, near_mask) as bool
+    [C, C], from this tick's columns and the previous packed state.
+
+    ``cfg`` is an :class:`interest.policy.StackConfig`-shaped object
+    (has_team / has_tier / has_los + the tier/los scalars); ``full``
+    selects the cadence:
+
+    * full step (every tick when there is no tier policy, every
+      ``period``-th otherwise): the whole composition re-evaluates --
+      base & team & (near | los).  Line-of-sight applies to the FAR
+      field only when a tier policy is present (near pairs are
+      unoccludable at close range by design; this is also what makes
+      tiered cadence cheaper -- off-steps skip every DF sample);
+    * off step (tier policy only): near pairs re-evaluate base & team
+      at full rate, far pairs HOLD their previous decision bit.
+
+    Tier assignment itself updates every step regardless of cadence (it
+    is compare-only, the cheap half), which is what makes two stacks
+    with different periods agree bit-exactly on coinciding boundary
+    ticks.
+    """
+    gate = pair_gate(act, xp)
+    if cfg.has_team:
+        gate = gate & team_mask(team, vis, xp)
+    base = base_mask(x, z, r, gate, xp)
+    if cfg.has_tier:
+        d = chebyshev(x, z, xp)
+        near = near_mask(d, r, prev_near, gate, cfg.near_frac,
+                         cfg.hysteresis, xp)
+    else:
+        near = None
+    if full:
+        if cfg.has_los:
+            clear = los_clear(x, z, grid, cfg.origin_x, cfg.origin_z,
+                              cfg.inv_cell, cfg.los_depth, xp)
+            final = base & (near | clear) if near is not None \
+                else base & clear
+        else:
+            final = base
+    else:
+        # off-cadence: near lanes live, far lanes frozen
+        final = (near & base) | (~near & prev_final)
+    if near is None:
+        near = xp.zeros(base.shape, bool)
+    return final, near

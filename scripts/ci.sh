@@ -69,7 +69,21 @@ JAX_PLATFORMS=cpu python scripts/ingest_smoke.py || fail=1
 echo "== checkpoint smoke =="
 JAX_PLATFORMS=cpu python scripts/checkpoint_smoke.py || fail=1
 
-# 11. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+# 11. interest-policy smoke (CPU backend: composed team+tier+LOS stack
+#    device vs CPU-oracle CRC parity, tiered-rate LOS saving at equal
+#    boundary words, aoi.interest demote + re-arm bit-exact --
+#    docs/perf.md "Interest policies & tiered rates")
+echo "== interest smoke =="
+JAX_PLATFORMS=cpu python scripts/interest_smoke.py || fail=1
+
+# 12. load-harness smoke (CPU backend: 10^5 scripted clients through the
+#    gate-batch -> columnar-ingest -> interest-stack path, batched-only,
+#    per-tier p50/p99 reported, all updates closed -- GW_LOADGEN_N
+#    overrides the fleet size)
+echo "== loadgen smoke =="
+JAX_PLATFORMS=cpu python scripts/loadgen_smoke.py || fail=1
+
+# 13. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
 #    over every declared seam, bit-exact parity + zero stuck buckets
 #    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
 if [ "${GW_SOAK:-0}" = "1" ]; then
@@ -80,7 +94,7 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 12. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 14. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

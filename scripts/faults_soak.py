@@ -229,6 +229,88 @@ def soak_ingest(seed: int, n=48, ticks=8) -> dict:
             "batched": st["batched"]}
 
 
+# the interest-policy stack demotes on ANY kind (the whole composition
+# falls back to the radius-only oracle path, sticky until reset_interest)
+INTEREST_KINDS = ["oom", "fail", "reset", "poison", "stall"]
+
+
+def soak_interest(seed: int, cap=128, ticks=8) -> dict:
+    """The ``aoi.interest`` seam in the randomized walk: a composed
+    team+tier+LOS stack demotes sticky to the radius-only path when its
+    spec fires (ANY kind), rides out the rest of the plan demoted, and
+    the operator re-arm (plan cleared + ``reset_interest``) plus two
+    clean ticks restores the full composition -- the whole stream
+    bit-exact against a reference twin driven through the same
+    demote/reset schedule on the CPU oracle."""
+    from goworld_tpu.interest import (DistanceField, LineOfSightPolicy,
+                                      PolicyStack, TeamVisibilityPolicy,
+                                      TieredRatePolicy)
+
+    def mk():
+        field = DistanceField.from_boxes(
+            [(20.0, 20.0, 45.0, 60.0), (-60.0, -10.0, -30.0, 10.0)],
+            (-100.0, -100.0), (200.0, 200.0), cell=5.0)
+        return [TeamVisibilityPolicy(), TieredRatePolicy(period=4),
+                LineOfSightPolicy(field, depth=2)]
+
+    rng = np.random.default_rng(seed)
+    kind = INTEREST_KINDS[int(rng.integers(len(INTEREST_KINDS)))]
+    at = int(rng.integers(2, ticks + 1))  # occurrence N = step index N-1
+    x = rng.uniform(-90, 90, cap).astype(np.float32)
+    z = rng.uniform(-90, 90, cap).astype(np.float32)
+    r = rng.uniform(10, 30, cap).astype(np.float32)
+    act = np.ones(cap, bool)
+    team = (np.uint32(1) << rng.integers(0, 4, cap)).astype(np.uint32)
+    vis = np.where(rng.random(cap) < 0.75, 0xFFFFFFFF, 0b1) \
+        .astype(np.uint32)
+    frames = []
+    for _ in range(ticks + 2):
+        x = (x + rng.uniform(-4, 4, cap)).astype(np.float32)
+        z = (z + rng.uniform(-4, 4, cap)).astype(np.float32)
+        frames.append((x.copy(), z.copy(), r, act, team, vis))
+
+    plan = faults.FaultPlan(seed=seed)
+    plan.add("aoi.interest", kind, at=at,
+             arg=0.001 if kind == "stall" else None)
+    faults.install(plan)
+    try:
+        dev = PolicyStack(cap, mk(), mode="device")
+        ev = []
+        for t, frame in enumerate(frames):
+            if t == ticks:  # operator re-arm, then two clean ticks
+                faults.clear()
+                dev.reset_interest()
+            dev.submit(*frame)
+            dev.step()
+            ev.append(dev.take_events())
+    finally:
+        faults.clear()
+    twin = PolicyStack(cap, mk(), mode="host")
+    for t, frame in enumerate(frames):
+        if t == at - 1:
+            twin.force_demote()
+        if t == ticks:
+            twin.reset_interest()
+        twin.submit(*frame)
+        twin.step()
+        te, tl = twin.take_events()
+        e, l = ev[t]
+        np.testing.assert_array_equal(e, te,
+                                      err_msg=f"enter t={t} seed={seed}")
+        np.testing.assert_array_equal(l, tl,
+                                      err_msg=f"leave t={t} seed={seed}")
+    assert len(plan.fired) == 1, \
+        f"aoi.interest spec never fired seed={seed}: {plan.fired}"
+    assert dev.stats["demotions"] == 1, f"seed={seed}: {dev.stats}"
+    assert dev.stats["resets"] == 1 and not dev.demoted, \
+        f"re-arm failed seed={seed}: {dev.stats}"
+    assert dev.stats["demoted_steps"] == ticks - (at - 1), \
+        f"seed={seed}: {dev.stats}"
+    assert np.array_equal(dev.words, twin.words)
+    return {"kind": kind, "at": at,
+            "demoted_steps": dev.stats["demoted_steps"]}
+
+
 # the durable-state seams (engine/checkpoint.py): every kind each guarded
 # op is built to absorb -- fail/oom/reset retry, stall rides the writer
 # thread, partial/poison land torn records the restore-side CRC catches
@@ -404,6 +486,7 @@ def main(argv):
         xt = bool(i % 2)
         a = soak_aoi(seed, cross_tick=xt)
         g = soak_ingest(seed)
+        it = soak_interest(seed)
         c = soak_checkpoint(seed)
         d = soak_dispatcher(seed)
         print(f"round {i + 1}/{rounds} seed={seed}"
@@ -413,12 +496,14 @@ def main(argv):
               f"page_spills={a['stats']['page_spills']} | "
               f"ingest {g['kind']} demoted={g['demoted']} "
               f"batched={g['batched']} | "
+              f"interest {it['kind']}@{it['at']} "
+              f"demoted_steps={it['demoted_steps']} | "
               f"ckpt fired={c['fired']} tick={c['restored_tick']} "
               f"torn={c['torn']} | "
               f"disp fired={d['fired']} replayed={d['replayed']} -- "
               f"bit-exact, no stuck buckets")
-    print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.ingest "
-          f"and store.*, parity held)")
+    print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.ingest, "
+          f"aoi.interest and store.*, parity held)")
     return 0
 
 

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""CPU-only smoke test of the interest-policy stack.
+
+A ci.sh step (and a standalone sanity check): the fused device pass for
+a composed team+tier+LOS policy stack must (a) match the composed CPU
+oracle bit-for-bit (event-stream CRC + word planes), (b) demote sticky
+to the radius-only path when the ``aoi.interest`` seam fires and re-arm
+bit-exactly via ``reset_interest``, and (c) show the tiered-rate saving:
+a period-4 stack emits bit-identical interest words on coinciding
+full-eval boundaries while evaluating a fraction of the line-of-sight
+samples.  Runs on the CPU backend in a few seconds -- docs/perf.md
+"Interest policies & tiered rates" describes the path under test.
+"""
+
+import os
+import sys
+import zlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+from goworld_tpu import faults  # noqa: E402
+from goworld_tpu.interest import (DistanceField, LineOfSightPolicy,  # noqa: E402
+                                  PolicyStack, TeamVisibilityPolicy,
+                                  TieredRatePolicy)
+
+CAP, TICKS = 128, 9  # two full tier periods + change
+
+
+def _field():
+    return DistanceField.from_boxes(
+        [(20.0, 20.0, 45.0, 60.0), (-60.0, -10.0, -30.0, 10.0)],
+        (-100.0, -100.0), (200.0, 200.0), cell=5.0)
+
+
+def _policies(period=4):
+    return [TeamVisibilityPolicy(), TieredRatePolicy(period=period),
+            LineOfSightPolicy(_field(), depth=2)]
+
+
+def _walk(seed, n):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(-90.0, 90.0, CAP).astype(np.float32)
+    z = rng.uniform(-90.0, 90.0, CAP).astype(np.float32)
+    r = rng.uniform(10.0, 30.0, CAP).astype(np.float32)
+    act = np.ones(CAP, bool)
+    team = (np.uint32(1) << rng.integers(0, 4, CAP)).astype(np.uint32)
+    vis = np.where(rng.random(CAP) < 0.75, 0xFFFFFFFF, 0b1) \
+        .astype(np.uint32)
+    for _ in range(n):
+        x = (x + rng.uniform(-4.0, 4.0, CAP)).astype(np.float32)
+        z = (z + rng.uniform(-4.0, 4.0, CAP)).astype(np.float32)
+        yield x.copy(), z.copy(), r, act, team, vis
+
+
+def _crc(crc, stack):
+    enter, leave = stack.take_events()
+    crc = zlib.crc32(enter.tobytes(), crc)
+    return zlib.crc32(leave.tobytes(), crc), enter.shape[0] + leave.shape[0]
+
+
+def main():
+    # 1. composed device vs CPU-oracle parity, CRC-folded event streams
+    dev = PolicyStack(CAP, _policies(), mode="device")
+    host = PolicyStack(CAP, _policies(), mode="host")
+    dcrc = hcrc = 0
+    n_events = 0
+    for frame in _walk(7, TICKS):
+        for s in (dev, host):
+            s.submit(*frame)
+            s.step()
+        dcrc, n = _crc(dcrc, dev)
+        hcrc, _ = _crc(hcrc, host)
+        n_events += n
+    assert n_events > 0, "degenerate walk: no events"
+    assert dcrc == hcrc, f"device/oracle CRC diverged: {dcrc:#x} != {hcrc:#x}"
+    assert np.array_equal(dev.words, host.words)
+    assert dev.stats["demotions"] == 0 and dev.stats["host_steps"] == 0
+
+    # 2. tiered rates: bit-identical words on every coinciding full-eval
+    #    boundary, at a fraction of the LOS samples
+    s4 = PolicyStack(CAP, _policies(period=4), mode="device")
+    s1 = PolicyStack(CAP, _policies(period=1), mode="device")
+    for t, frame in enumerate(_walk(11, TICKS)):
+        for s in (s4, s1):
+            s.submit(*frame)
+            s.step()
+        if t % 4 == 0:  # both just ran a full eval (cadence at step entry)
+            assert np.array_equal(s4.words, s1.words), \
+                f"tier boundary t={t} diverged"
+    assert s4.stats["full_evals"] == 3 and s1.stats["full_evals"] == TICKS
+    assert s4.stats["los_pair_evals"] < s1.stats["los_pair_evals"]
+
+    # 3. the aoi.interest seam: sticky demotion, then a bit-exact re-arm
+    #    (reference twin runs the same demote/reset schedule explicitly)
+    fire_at, reset_at = 3, 6  # occurrence 3 => demoted from step index 2
+    faults.install(f"aoi.interest:fail@{fire_at}")
+    injected = PolicyStack(CAP, _policies(), mode="device")
+    icrc = 0
+    frames = list(_walk(13, TICKS))
+    for t, frame in enumerate(frames):
+        if t == reset_at:
+            injected.reset_interest()
+        injected.submit(*frame)
+        injected.step()
+        icrc, _ = _crc(icrc, injected)
+    faults.clear()
+    twin = PolicyStack(CAP, _policies(), mode="host")
+    tcrc = 0
+    for t, frame in enumerate(frames):
+        if t == fire_at - 1:
+            twin.force_demote()
+        if t == reset_at:
+            twin.reset_interest()
+        twin.submit(*frame)
+        twin.step()
+        tcrc, _ = _crc(tcrc, twin)
+    assert injected.stats["demotions"] == 1, injected.stats
+    assert injected.stats["resets"] == 1, injected.stats
+    assert injected.stats["demoted_steps"] == reset_at - (fire_at - 1), \
+        injected.stats
+    assert icrc == tcrc, "demote/re-arm stream diverged from reference twin"
+    assert np.array_equal(injected.words, twin.words)
+
+    print(f"interest_smoke: OK -- {TICKS} ticks bit-exact "
+          f"(crc {dcrc:#010x}, {n_events} events); tiered LOS samples "
+          f"{s4.stats['los_pair_evals']} vs {s1.stats['los_pair_evals']}; "
+          f"demote@{fire_at} + re-arm@{reset_at} bit-exact")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""CPU-only smoke of the scripted-client load harness at 10^5 clients.
+
+A ci.sh step (and a standalone sanity check): the vectorized fleet
+(goworld_tpu/load/) must push 10^5 scripted clients' sync batches
+through the batched columnar ingest front door -- zero per-entity
+Python writes, zero demoted batches -- drive the per-space interest
+stacks on cadence, and report per-interest-tier e2e latency
+percentiles with every client's last update closed by the final
+full-eval tick.  ``GW_LOADGEN_N`` overrides the client count (e.g. a
+10^6 run on beefier hardware).  docs/perf.md "Interest policies &
+tiered rates" describes the path under test.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from goworld_tpu.load import LoadHarness  # noqa: E402
+
+
+def main():
+    n = int(os.environ.get("GW_LOADGEN_N", "100000"))
+    period = 4
+    ticks = 2 * period + 1  # ends on a full-cadence step: far tier closes
+    hz = LoadHarness(n, n_spaces=256, n_gates=8, period=period,
+                     aoi_backend="cpu", interest_mode="host", seed=11)
+    report = hz.run(ticks)
+
+    assert report["records"] == n * ticks, report["records"]
+    ing = report["ingest"]
+    assert ing["batched"] >= ticks * 8, ing  # every gate batch, every tick
+    assert ing["per_entity_writes"] == 0, ing
+    assert ing.get("demoted_batches", 0) == 0, ing
+    assert report["unclosed"] == 0, "pending updates survived the last full eval"
+    tiers = report["tiers"]
+    for tier in ("near", "far"):
+        assert tiers[tier]["n"] > 0, f"no {tier}-tier samples: {tiers}"
+        assert "p50_ms" in tiers[tier] and "p99_ms" in tiers[tier]
+    agg = report["interest"]
+    assert agg["steps"] == 256 * ticks, agg
+    assert agg["full_evals"] == 256 * 3, agg  # cadence: steps 0, 4, 8
+    assert agg["demotions"] == 0 and agg["host_steps"] == 0, agg
+
+    print(f"loadgen_smoke: OK -- {n} clients x {ticks} ticks, "
+          f"{report['moves_per_s']:.0f} moves/s batched-only; "
+          f"near p50/p99 {tiers['near']['p50_ms']:.1f}/"
+          f"{tiers['near']['p99_ms']:.1f} ms, "
+          f"far p50/p99 {tiers['far']['p50_ms']:.1f}/"
+          f"{tiers['far']['p99_ms']:.1f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,200 @@
+"""examples/test_client.py wire-protocol coverage.
+
+Two layers:
+
+* the byte pin: one MT_SYNC_POSITION_YAW_FROM_CLIENT packet built the
+  way ``GameClientConnection.send_position`` builds it carries exactly
+  one ``ingest.SYNC_RECORD`` after the u16 msgtype -- the layout the
+  gate's sync coalescing forwards verbatim and the load harness's
+  ``GateBatcher`` replicates (goworld_tpu/load/clients.py);
+* the live round-trip: the example's actual ``Bot`` (strict mode) runs
+  its entry/move script against a real dispatcher+game+gate cluster
+  over localhost TCP, and every move it sends lands server-side through
+  the batched columnar ingest, bit-exact f32.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from goworld_tpu import config as gwconfig
+from goworld_tpu.client import GameClientConnection
+from goworld_tpu.components.dispatcher.service import DispatcherService
+from goworld_tpu.components.game.service import GameService
+from goworld_tpu.components.gate.service import GateService
+from goworld_tpu.engine.entity import Entity
+from goworld_tpu.engine.rpc import OWN_CLIENT, rpc
+from goworld_tpu.engine.space import Space
+from goworld_tpu.engine.vector import Vector3
+from goworld_tpu.ingest.movement import RECORD_SIZE, SYNC_RECORD
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.proto import msgtypes as MT
+
+
+def _load_example():
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "examples", "test_client.py")
+    spec = importlib.util.spec_from_file_location("example_test_client",
+                                                  os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_sync_packet_is_one_sync_record():
+    """The client sync packet IS a SYNC_RECORD behind the u16 msgtype --
+    which is what lets the gate coalesce records by concatenation and
+    the load harness replicate gate batches from numpy arrays."""
+    eid = "wirepin000000042"
+    x, y, z, yaw = 12.25, 1.5, -7.75, 0.5
+    p = Packet.for_msgtype(MT.MT_SYNC_POSITION_YAW_FROM_CLIENT)
+    p.append_entity_id(eid)
+    p.append_bytes(struct.pack("<ffff", x, y, z, yaw))
+    buf = bytes(p.payload)
+    assert struct.unpack_from("<H", buf)[0] == 60  # pinned wire value
+    body = buf[2:]
+    assert len(body) == RECORD_SIZE == 32
+    rec = np.frombuffer(body, SYNC_RECORD)[0]
+    assert rec["eid"] == eid.encode("ascii")
+    assert (rec["x"], rec["y"], rec["z"], rec["yaw"]) == \
+        (np.float32(x), np.float32(y), np.float32(z), np.float32(yaw))
+    # and the reverse: a numpy-built record is the same bytes
+    arr = np.zeros(1, SYNC_RECORD)
+    arr["eid"], arr["x"], arr["y"], arr["z"], arr["yaw"] = \
+        eid.encode("ascii"), x, y, z, yaw
+    assert arr.tobytes() == body
+
+
+# -- live gate round-trip ----------------------------------------------------
+
+CONFIG = """
+[deployment]
+dispatchers = 1
+games = 1
+gates = 1
+
+[dispatcher1]
+port = 0
+
+[game_common]
+boot_entity = WireAvatar
+aoi_backend = cpu
+
+[gate1]
+port = 0
+heartbeat_timeout_s = 0
+"""
+
+
+class WireScene(Space):
+    pass
+
+
+class WireAvatar(Entity):
+    """The ``enter_game``/move surface examples/test_client.py's Bot
+    drives (the unity_demo avatar's shape, minus the monsters)."""
+
+    use_aoi = True
+    aoi_distance = 100.0
+    all_client_attrs = frozenset({"name"})
+
+    def on_created(self):
+        self.set_client_syncing(True)
+
+    @rpc(expose=OWN_CLIENT)
+    def enter_game(self, name):
+        self.attrs.set("name", name)
+        scene_id = self._runtime().game.srvmap.get("scene")
+        if scene_id:
+            self.enter_space(scene_id, Vector3(10.0, 0.0, 10.0))
+
+
+@pytest.fixture()
+def wire_cluster(tmp_path):
+    cfg = gwconfig.loads(CONFIG)
+    disp = DispatcherService(1, cfg).start()
+    cfg.dispatchers[1].host, cfg.dispatchers[1].port = disp.addr
+    game = GameService(1, cfg, freeze_dir=str(tmp_path))
+    game.register_entity_type(WireScene)
+    game.register_entity_type(WireAvatar)
+    game.start()
+    gate = GateService(1, cfg).start()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not game.deployment_ready:
+        time.sleep(0.01)
+    assert game.deployment_ready, "deployment never became ready"
+
+    def make_scene():
+        sp = game.rt.entities.create_space("WireScene", kind=1)
+        sp.enable_aoi(100.0)
+        game.declare_service("scene", sp.id)
+
+    game.rt.post.post(make_scene)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and "scene" not in game.srvmap:
+        time.sleep(0.01)
+    assert "scene" in game.srvmap, "srvdis never propagated"
+    yield disp, game, gate
+    gate.stop()
+    game.stop()
+    disp.stop()
+
+
+def test_entry_move_roundtrip_live_gate(wire_cluster):
+    """Entry + move through the real gate: the enter_game attr write
+    round-trips onto the client mirror, and a position sync lands on the
+    server entity bit-exact f32 -- through the batched columnar ingest,
+    never the per-entity fallback."""
+    _disp, game, gate = wire_cluster
+    c = GameClientConnection(gate.addr)
+    assert c.wait_for(lambda c: c.player is not None, 10.0), "no boot entity"
+    c.call_player("enter_game", "wirebot")
+    assert c.wait_for(
+        lambda c: c.player is not None
+        and c.player.attrs.get("name") == "wirebot", 10.0), \
+        "enter_game attr never mirrored"
+    eid = c.player.id
+    x, z, yaw = 123.4, 56.7, 0.89  # non-representable: f32 rounding is the pin
+    c.send_position(x, 1.5, z, yaw)
+    want = (float(np.float32(x)), float(np.float32(1.5)),
+            float(np.float32(z)))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        e = game.rt.entities.get(eid)
+        if e is not None and tuple(e.position.to_tuple()) == want:
+            break
+        time.sleep(0.02)
+    e = game.rt.entities.get(eid)
+    assert e is not None and tuple(e.position.to_tuple()) == want, \
+        "position sync never landed bit-exact"
+    assert e.yaw == float(np.float32(yaw))
+    assert game.ingest.stats["batched"] >= 1, "columnar ingest path not taken"
+    assert game.ingest.stats["per_entity_writes"] == 0
+    c.close()
+
+
+def test_example_bot_strict_against_live_gate(wire_cluster):
+    """The example's own Bot (strict mode) completes its entry/move
+    script against the live cluster: login, enter_game attr round-trip,
+    a few seconds of send_position/poll ticks, clean close -- with every
+    strict-mode protocol invariant armed."""
+    _disp, game, gate = wire_cluster
+    tc = _load_example()
+    stats, truth = tc.Stats(), tc.SharedTruth()
+    bot = tc.Bot(gate.addr, 0, duration=2.0, strict=True, stats=stats,
+                 truth=truth)
+    bot.start()
+    bot.join(40)
+    assert not bot.is_alive(), "bot hung"
+    assert bot.ok, f"bot failed: {bot.error}"
+    assert stats.samples.get("login"), "no login sample"
+    assert len(stats.samples.get("tick", [])) > 0, "bot never ticked"
+    # the bot's moves all went through the batched wire->column path
+    assert game.ingest.stats["batched"] >= 1
+    assert game.ingest.stats["per_entity_writes"] == 0

@@ -55,8 +55,9 @@ def test_seam_catalog_stable():
     assert set(faults.SEAMS) == {
         "aoi.grow", "aoi.h2d", "aoi.delta", "aoi.kernel", "aoi.scalars",
         "aoi.fetch", "aoi.emit", "aoi.device", "aoi.pages", "aoi.ingest",
-        "conn.send", "conn.flush", "conn.recv", "disp.connect",
-        "bench.config", "store.write", "store.read", "store.manifest"}
+        "aoi.interest", "conn.send", "conn.flush", "conn.recv",
+        "disp.connect", "bench.config", "store.write", "store.read",
+        "store.manifest"}
     assert set(faults.KINDS) == {
         "oom", "fail", "stall", "poison", "reset", "partial"}
 

@@ -17,8 +17,8 @@ import textwrap
 from pathlib import Path
 
 from goworld_tpu.analysis import coverage, determinism, dtypes, \
-    fault_seams, flush_phase, h2d_staging, host_sync, telemetry_rule, \
-    wire_protocol
+    fault_seams, flush_phase, h2d_staging, host_sync, oracle_parity, \
+    telemetry_rule, wire_protocol
 from goworld_tpu.analysis.__main__ import main as gwlint_main
 from goworld_tpu.analysis.core import run
 
@@ -835,6 +835,98 @@ def test_bounded_caps_out_of_scope_files_untouched(tmp_path):
                    "def f(self):\n"
                    "    return jnp.zeros((self.max_n,), jnp.int32)\n"})
     findings, _ = _run(tmp_path, [bounded_caps.check])
+    assert findings == []
+
+
+# -- oracle-parity -----------------------------------------------------------
+
+POLICIES = """\
+    import numpy as np
+
+    def register(cls):
+        return cls
+
+    class InterestPolicy:
+        def oracle(self, cols):
+            raise NotImplementedError
+
+    @register
+    class GoodPolicy(InterestPolicy):
+        name = "good"
+
+        def oracle(self, cols):
+            return np.ones_like(cols)
+
+    @register
+    class NoOracle(InterestPolicy):
+        name = "no_oracle"
+
+    @register
+    class NoName(InterestPolicy):
+        def oracle(self, cols):
+            return cols
+
+    class DeadNamed(InterestPolicy):
+        name = "dead"
+
+        def oracle(self, cols):
+            return cols
+
+    @register
+    class Untested(InterestPolicy):
+        name = "untested"
+
+        def oracle(self, cols):
+            return cols
+
+    class Grandfathered(InterestPolicy):  # gwlint: allow[oracle-parity] -- fixture: migration shim
+        name = "shim"
+
+    class _Helper:
+        name = "not a policy -- no base, no decorator"
+"""
+
+
+def test_oracle_parity_flags_each_rot(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/interest/policy.py": POLICIES,
+        "tests/test_i.py":
+            "def test_parity():\n"
+            "    assert 'GoodPolicy NoOracle NoName DeadNamed'\n",
+    })
+    findings, _ = _run(tmp_path, [oracle_parity.check],
+                       tests_dir=str(tmp_path / "tests"))
+    by_sym = {f.symbol: f for f in findings}
+    assert set(by_sym) == {"NoOracle", "NoName", "DeadNamed", "Untested"}, \
+        sorted(f.render() for f in findings)
+    # each finding lands on its class def line, with the right story
+    assert by_sym["NoOracle"].line == _ln(POLICIES, "class NoOracle")
+    assert "no CPU oracle" in by_sym["NoOracle"].message
+    assert by_sym["NoName"].line == _ln(POLICIES, "class NoName")
+    assert "no class-level name constant" in by_sym["NoName"].message
+    assert by_sym["DeadNamed"].line == _ln(POLICIES, "class DeadNamed")
+    assert "never @register-ed" in by_sym["DeadNamed"].message
+    assert by_sym["Untested"].line == _ln(POLICIES, "class Untested")
+    assert "never referenced from tests/" in by_sym["Untested"].message
+    # GoodPolicy (registered+named+oracle+tested), the allow'd shim, the
+    # InterestPolicy base and the non-policy helper are all clean
+    for clean in ("GoodPolicy", "Grandfathered", "InterestPolicy", "_Helper"):
+        assert clean not in by_sym
+
+
+def test_oracle_parity_scope_is_interest_dirs(tmp_path):
+    """The same rot outside an interest/ directory is not this rule's
+    business (and tests/ fixture policies are never scanned)."""
+    rotted = ("class InterestPolicy:\n"
+              "    pass\n"
+              "class NoOracle(InterestPolicy):\n"
+              "    name = 'x'\n")
+    _mk(tmp_path, {
+        "goworld_tpu/engine/policy.py": rotted,
+        "tests/interest/conftest.py": rotted,
+    })
+    findings, _ = _run(tmp_path, [oracle_parity.check],
+                       tests_dir=str(tmp_path / "tests"))
     assert findings == []
 
 

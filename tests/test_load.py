@@ -1,0 +1,96 @@
+"""Scripted-client load harness (goworld_tpu/load/).
+
+The contract under test (docs/perf.md "Interest policies & tiered
+rates" -- the load half):
+
+* the harness drives its whole fleet through the BATCHED ingest front
+  door (``MovementIngest``): per-gate wire batches, zero per-entity
+  fallback writes;
+* the per-interest-tier e2e latency split is real: both tiers sample,
+  every pending update closes when the run ends on a full-cadence step
+  (``ticks = m * period + 1``), and far-tier closures only happen on
+  full steps;
+* the fleet script is deterministic (seeded) and the gate batches are
+  byte-identical to ``SYNC_RECORD`` arrays -- the same layout
+  tests/test_client_wire.py pins against the real client encoder;
+* the ``load.clients`` gauge and ``load.moves`` counter exist under
+  their documented names (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from goworld_tpu import telemetry
+from goworld_tpu.ingest.movement import RECORD_SIZE, SYNC_RECORD
+from goworld_tpu.load import GateBatcher, LoadHarness, ScriptedFleet
+
+
+def test_fleet_deterministic_and_bounded():
+    a, b = ScriptedFleet(64, seed=3), ScriptedFleet(64, seed=3)
+    for _ in range(5):
+        a.step()
+        b.step()
+    assert np.array_equal(a.x, b.x) and np.array_equal(a.z, b.z)
+    assert np.array_equal(a.yaw, b.yaw)
+    assert np.abs(a.x).max() <= a.world_half + a.speed
+    assert np.abs(a.z).max() <= a.world_half + a.speed
+    c = ScriptedFleet(64, seed=4)
+    c.step()
+    assert not np.array_equal(a.x, c.x)  # the seed is the script
+
+
+def test_gate_batches_are_sync_record_bytes():
+    n, gates = 10, 3
+    fleet = ScriptedFleet(n, seed=1)
+    fleet.step()
+    eids = [f"e{i:015d}" for i in range(n)]
+    batcher = GateBatcher(eids, gates)
+    bufs = batcher.batches(fleet)
+    assert len(bufs) == gates
+    total = 0
+    for g, buf in enumerate(bufs):
+        assert len(buf) % RECORD_SIZE == 0
+        rec = np.frombuffer(buf, SYNC_RECORD)
+        idx = np.arange(g, n, gates)
+        total += len(rec)
+        assert [e.decode() for e in rec["eid"]] == [eids[i] for i in idx]
+        assert np.array_equal(rec["x"], fleet.x[idx])
+        assert np.array_equal(rec["z"], fleet.z[idx])
+        assert np.array_equal(rec["yaw"], fleet.yaw[idx])
+    assert total == n
+
+
+def test_harness_batched_only_and_tier_split():
+    period = 4
+    h = LoadHarness(n_clients=512, n_spaces=4, n_gates=4, period=period,
+                    interest_mode="host", seed=11)
+    ticks = 2 * period + 1  # ends on a full-cadence step
+    rep = h.run(ticks)
+    assert rep["clients"] == 512 and rep["ticks"] == ticks
+    assert rep["records"] == 512 * ticks
+    # the whole fleet goes through the batched front door: no per-entity
+    # fallback writes, no demoted batches
+    assert rep["ingest"]["per_entity_writes"] == 0
+    assert rep["ingest"]["demoted_batches"] == 0
+    assert rep["ingest"]["records"] == rep["records"]
+    # both tiers sample; ending on a full step closes every pending update
+    assert rep["unclosed"] == 0
+    assert rep["tiers"]["near"]["n"] > 0
+    assert rep["tiers"]["far"]["n"] > 0
+    assert rep["tiers"]["near"]["p99_ms"] >= rep["tiers"]["near"]["p50_ms"]
+    assert rep["moves_per_s"] > 0
+    # tiered cadence did its job: 3 full evals (steps 0, 4, 8), the rest
+    # off-cadence, across all 4 stacks
+    agg = rep["interest"]
+    assert agg["steps"] == 4 * ticks
+    assert agg["full_evals"] == 4 * 3
+    assert agg["demotions"] == 0
+
+
+def test_load_telemetry_names_registered():
+    from goworld_tpu.load import harness as hz
+
+    reg = telemetry.registry()
+    assert hz._LOAD_CLIENTS is reg.gauge("load.clients")
+    assert hz._LOAD_MOVES is reg.counter("load.moves")
