@@ -1126,7 +1126,8 @@ def _timed(fn):
 
 def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
                  movers_frac=None, delta_staging=True, flush_sched=True,
-                 cap_mix=False, aoi_emit="auto", cross_tick=False):
+                 cap_mix=False, aoi_emit="auto", cross_tick=False,
+                 fused=False, fused_ab=False):
     """Engine-level number: ``Runtime.tick`` end-to-end.
 
     Movement drive:
@@ -1180,6 +1181,14 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     ``pipeline``, so a ``cross_tick`` run's ``parity_checksum`` must
     equal the ``pipeline`` run's on the same walk (same stream, same
     single shift).
+
+    ``fused`` compiles the steady tick into ONE device program
+    (docs/perf.md "Fused dispatch"; ``Runtime(aoi_fused=True)``).
+    ``fused_ab=True`` names the row ``engine_fused`` so the fused and
+    unfused sides pair up in the recap; the acceptance meter is
+    ``device_dispatches_per_tick`` (1 fused vs 2 unfused, counted at
+    the jitted-call sites via ops/dispatch_count) with a bit-identical
+    ``parity_checksum``.
     """
     import jax
 
@@ -1208,7 +1217,7 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     rt = Runtime(aoi_backend=backend, aoi_pipeline=pipeline,
                  aoi_delta_staging=delta_staging,
                  aoi_flush_sched=flush_sched, aoi_emit=aoi_emit,
-                 aoi_cross_tick=cross_tick)
+                 aoi_cross_tick=cross_tick, aoi_fused=fused)
     rt.entities.register(BenchScene)
     rt.entities.register(BenchMob)
     rt.entities.register(BenchWatcher)
@@ -1367,6 +1376,11 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
 
     telemetry.enable()
     gwtrace.reset()
+    # device program launches over the measured window (ops/dispatch_count,
+    # counted at every jitted-call site): the fused mode's acceptance meter
+    from goworld_tpu.ops import dispatch_count as _DC
+
+    _DC.reset()
     dt = float("inf")
     for _rep in range(reps):
         t0 = time.perf_counter()
@@ -1376,12 +1390,19 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     for _name, _tid, _s0, _s1 in gwtrace.spans():
         span_s[_name] = span_s.get(_name, 0.0) + (_s1 - _s0)
     telemetry.disable()
+    device_dispatches = _DC.read()
     kind = backend + ("+pipeline" if pipeline else "") \
         + ("+xtick" if cross_tick else "")
+    if fused_ab:
+        kind += "+fused" if fused else "+unfused"
+    elif fused:
+        kind += "+fused"
     if aoi_emit != "auto":
         kind += f"+emit={aoi_emit}"
     drive = "bulk move_entities" if bulk else "per-entity set_position"
-    if cap_mix:
+    if fused_ab:
+        config = "engine_fused"
+    elif cap_mix:
         config = "engine_sched"
         kind += "+sched" if flush_sched else "+seq"
     elif movers_frac is not None:
@@ -1454,6 +1475,10 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
     if out["phase_ms"].get("kernel"):
         out["wall_vs_device_ratio"] = round(
             out["tick_ms"] / max(out["phase_ms"]["kernel"], 1e-3), 2)
+    # program launches per steady tick (the fused A/B meter; D2H fetches
+    # and async prefetch slices are not launches and are not counted)
+    out["device_dispatches_per_tick"] = round(
+        device_dispatches / total_ticks, 2)
     # split-phase scheduler A/B bookkeeping (docs/perf.md): the checksum
     # folds every delivered enter/leave pair in delivery order, so a
     # scheduler-on and scheduler-off run of the same config must print the
@@ -1486,6 +1511,15 @@ def bench_engine(cfg, backend=None, pipeline=False, bulk=False, watchers=1,
         if "decode_overflow" in stats1:
             out["aoi_decode_overflow"] = (stats1["decode_overflow"]
                                           - stats0.get("decode_overflow", 0))
+        if fused:
+            # fused-path bookkeeping: how many measured ticks ran as one
+            # program, and how many a seam fault demoted (docs/perf.md)
+            out["aoi_fused_dispatches"] = (
+                stats1.get("fused_dispatches", 0)
+                - stats0.get("fused_dispatches", 0))
+            out["aoi_fused_demotions"] = (
+                stats1.get("fused_demotions", 0)
+                - stats0.get("fused_demotions", 0))
     return out
 
 
@@ -2440,6 +2474,20 @@ def main():
                 # the same A/B under the cross-tick scheduler (+xtick):
                 # both sides defer one tick, parity bar unchanged
                 emit(bench_engine_ingest(cfg, cross_tick=True))
+                # fused one-dispatch A/B (docs/perf.md "Fused dispatch"),
+                # platform-agnostic like the rows above but bounded small
+                # (the meter is device_dispatches_per_tick -- 1 fused vs 2
+                # unfused -- not scale): same sparse bulk walk, steady tick
+                # compiled into ONE program vs the scatter+step baseline;
+                # parity_checksum must be bit-identical between the sides
+                # one space so disp_pt reads per-BUCKET (1.0 vs 2.0), the
+                # same number tests/test_fused.py pins
+                fcfg = Config("engine", 1, 1024, cfg.world, cfg.radius,
+                              n_active=768, ticks=10)
+                emit(bench_engine(fcfg, "tpu", bulk=True, movers_frac=0.1,
+                                  fused=True, fused_ab=True))
+                emit(bench_engine(fcfg, "tpu", bulk=True, movers_frac=0.1,
+                                  fused=False, fused_ab=True))
                 # interest-policy tiered-rate A/B + the scripted-client
                 # load harness (docs/perf.md "Interest policies & tiered
                 # rates"), platform-agnostic like the rows above: equal
@@ -2544,6 +2592,29 @@ def main():
         except Exception as e:
             print(f"# headline end-window failed: {e!r}", file=sys.stderr,
                   flush=True)
+    # cross-tick sanity (BENCH_r08 finding: engine_ingest+xtick slower
+    # than its baseline on the CPU container): the deferral only WINS when
+    # there is device/wire time to hide under the next host tick -- with
+    # no accelerator both sides run the same host work and +xtick adds
+    # pure deferral bookkeeping, so losing here is expected and flagged,
+    # not fatal; on an accelerator the same warning firing means the
+    # overlap is broken (docs/perf.md cross-tick pipelining)
+    by_cfg = {o.get("config"): o for o in lines}
+    for base_name in [c[:-len("+xtick")] for c in by_cfg
+                      if c and c.endswith("+xtick")]:
+        b, xt = by_cfg.get(base_name), by_cfg.get(base_name + "+xtick")
+        if not (b and xt and "ms_per_tick" in b and "ms_per_tick" in xt):
+            continue
+        if xt["ms_per_tick"] > b["ms_per_tick"]:
+            print(json.dumps({
+                "metric": "recap", "config": base_name + "+xtick",
+                "warning": "xtick_slower_than_baseline",
+                "ms": xt["ms_per_tick"], "base_ms": b["ms_per_tick"],
+                "no_accel": bool(xt.get("accelerator_absent")),
+                "note": ("expected off-accelerator (nothing to overlap; "
+                         "docs/perf.md cross-tick pipelining); "
+                         "investigate if a real device shows this")}),
+                flush=True)
     for o in lines:
         rec = {"metric": "recap", "config": o.get("config")}
         for src, dst in (("kind", "kind"), ("value", "value"),
@@ -2557,6 +2628,9 @@ def main():
                          ("wire_MBps", "wire_MBps"),
                          ("auto_backend", "auto"),
                          ("wall_vs_device_ratio", "wall_dev"),
+                         ("device_dispatches_per_tick", "disp_pt"),
+                         ("aoi_fused_dispatches", "fused_n"),
+                         ("aoi_fused_demotions", "fused_demo"),
                          ("aoi_emit", "emit"),
                          ("aoi_emit_path", "emit_path"),
                          ("aoi_decode_overflow", "dec_ovf"),

@@ -1,6 +1,6 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Eleven checkers, each
+Run as ``python -m goworld_tpu.analysis <paths>``.  Twelve checkers, each
 an AST pass over the tree (stdlib-only -- no jax import needed):
 
 ===================  =====================================================
@@ -19,6 +19,8 @@ telemetry            every metric/span name is documented + tested; the
                      telemetry package never syncs the device
 flush-phase          no host-sync call reachable from a bucket dispatch()
                      body (the split-phase scheduler's overlap contract)
+fused-dispatch       no host-sync call reachable from the fused one-launch
+                     step (its one-enqueue-per-tick contract)
 bounded-caps         cap-shaped device buffers carry a counted overflow
                      fallback (no silent fixed-cap truncation)
 oracle-parity        every registered InterestPolicy declares a CPU
@@ -31,8 +33,8 @@ See docs/static-analysis.md for the suppression story.
 from __future__ import annotations
 
 from . import (bounded_caps, coverage, determinism, dtypes, fault_seams,
-               flush_phase, h2d_staging, host_sync, oracle_parity,
-               telemetry_rule, wire_protocol)
+               flush_phase, fused_dispatch, h2d_staging, host_sync,
+               oracle_parity, telemetry_rule, wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
 CHECKERS = [
@@ -45,6 +47,7 @@ CHECKERS = [
     fault_seams.check,
     telemetry_rule.check,
     flush_phase.check,
+    fused_dispatch.check,
     bounded_caps.check,
     oracle_parity.check,
 ]

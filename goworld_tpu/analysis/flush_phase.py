@@ -22,9 +22,16 @@ plus every module function of ops/aoi_emit.py) run on already-fetched
 host arrays, so a blocking device fetch reached from one re-serializes
 the harvest drain the split-phase scheduler just overlapped.
 
+The fused pipeline (ops/aoi_fused.py) is a third entry-point set: its
+module functions are dispatch-phase code by construction -- they run
+inside the bucket's fused attempt (``*_fused*`` methods, which the
+dispatch() walk already reaches through ``self._dispatch_fused``) -- so
+they get the same pure-enqueue treatment; the dedicated fused-dispatch
+rule layers the fused-specific diagnosis on top.
+
 Scope: the bucket modules (engine/aoi.py, engine/aoi_mesh.py,
-engine/aoi_rowshard.py) and the emit layer (ops/aoi_emit.py, emit
-entry points only).
+engine/aoi_rowshard.py), the emit layer (ops/aoi_emit.py, emit
+entry points only), and the fused programs (ops/aoi_fused.py).
 """
 
 from __future__ import annotations
@@ -40,12 +47,17 @@ SCOPE = ("engine/aoi.py", "engine/aoi_mesh.py", "engine/aoi_rowshard.py")
 # the emit layer: walked as its own entry-point set (harvest publish
 # helpers must not re-enter blocking device fetches)
 EMIT_SCOPE = SCOPE + ("ops/aoi_emit.py",)
+# the fused programs: dispatch-phase code by construction (they run
+# inside the bucket's fused attempt), every module function an entry
+FUSED_SCOPE = EMIT_SCOPE + ("ops/aoi_fused.py",)
 
 _DISPATCH_REASON = ("dispatch() must be pure enqueue (docs/perf.md: the "
                     "scheduler overlap dies at the first blocking fetch)")
 _EMIT_REASON = ("harvest emit helpers run on already-fetched arrays and "
                 "must not re-enter a blocking device fetch (docs/perf.md "
                 "emit paths)")
+_FUSED_REASON = ("the fused step is dispatch-phase code -- one enqueue, "
+                 "one async fetch (docs/perf.md 'Fused dispatch')")
 
 
 def _sync_msg(node: ast.Call) -> str | None:
@@ -119,9 +131,14 @@ def _has_allow(sf: SourceFile, line: int) -> bool:
 
 
 def check(ctx: Context):
-    files = ctx.files_matching(*EMIT_SCOPE)
+    files = ctx.files_matching(*FUSED_SCOPE)
     graph = _Graph(files)
     for sf in files:
+        if sf.rel.endswith("ops/aoi_fused.py"):
+            # every fused program is dispatch-phase: pure enqueue
+            for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
+                yield from _walk(graph, "", name, fn, fsf, _FUSED_REASON)
+            continue
         emit_layer = sf.rel.endswith("ops/aoi_emit.py")
         if emit_layer:
             # every module function of the emit layer is an entry point

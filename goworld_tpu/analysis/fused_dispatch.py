@@ -1,0 +1,108 @@
+"""fused-dispatch: nothing host-syncs inside the fused one-launch step.
+
+The fused pipeline (ops/aoi_fused, docs/perf.md "Fused dispatch") buys
+its one-enqueue-per-tick shape by keeping the whole steady tick -- delta
+scatter -> neighbor kernel -> diff -> triple extraction / page
+allocation -- inside one jitted program plus one async D2H fetch.  A
+single host-sync call reachable from the fused attempt (a stray
+``np.asarray`` on a device value, an ``.item()`` "just to check", a
+``block_until_ready``) silently re-serializes the tick: the program
+still runs, parity still holds, and the dispatch is back to paying a
+blocking round-trip -- exactly the overhead the fused mode exists to
+delete.  Worse than the flush-phase failure mode, it also hides in the
+A/B: the fused row keeps winning on dispatch COUNT while losing the
+wall-clock it was built to reclaim.
+
+Entry points walked (the flush-phase call-graph machinery, one taxonomy
+shared with host-sync):
+
+* every module function of ops/aoi_fused.py (the fused programs and
+  their lazy impl builders);
+* every ``*_fused*`` method of the bucket tiers (eligibility check,
+  packet build, seam checks, and the enqueue around the program call).
+
+Boundaries are explicit: ``# gwlint: allow[fused-dispatch] -- <why>`` on
+the call or callee ``def`` line stops the traversal (demotion recovery
+is host-side by design and lives on the unfused path anyway).
+
+Scope: the bucket modules (engine/aoi.py, engine/aoi_mesh.py,
+engine/aoi_rowshard.py) and ops/aoi_fused.py.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, SourceFile
+from .flush_phase import _Graph, _sync_msg
+
+RULE = "fused-dispatch"
+
+SCOPE = ("engine/aoi.py", "engine/aoi_mesh.py", "engine/aoi_rowshard.py",
+         "ops/aoi_fused.py")
+
+_REASON = ("the fused step is one enqueue + one async fetch (docs/perf.md "
+           "'Fused dispatch'); a host sync here re-serializes the tick the "
+           "fusion exists to overlap")
+
+
+def _has_allow(sf: SourceFile, line: int) -> bool:
+    rules = sf.allow.get(line)
+    return bool(rules) and (RULE in rules or "*" in rules)
+
+
+def check(ctx: Context):
+    files = ctx.files_matching(*SCOPE)
+    graph = _Graph(files)
+    for sf in files:
+        if sf.rel.endswith("ops/aoi_fused.py"):
+            # every fused program (module function) is an entry point
+            for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
+                yield from _walk(graph, "", name, fn, fsf)
+            continue
+        for cls in sf.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for name, (m, msf) in graph.classes.get(
+                    cls.name, ([], {}))[1].items():
+                if msf is sf and "_fused" in name:
+                    yield from _walk(graph, cls.name, name, m, msf)
+
+
+def _walk(graph: _Graph, cls: str, entry_name: str, entry_node, entry_sf):
+    visited: set[tuple[str, int]] = set()
+    display = f"{cls}.{entry_name}" if cls else entry_name
+    queue = [(entry_node, entry_sf, display)]
+    while queue:
+        fn, sf, path = queue.pop(0)
+        key = (sf.rel, fn.lineno)
+        if key in visited:
+            continue
+        visited.add(key)
+        if _has_allow(sf, fn.lineno):
+            continue  # whole callee is a declared boundary
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = _sync_msg(node)
+            if msg is not None:
+                yield Finding(
+                    RULE, sf.rel, node.lineno, node.col_offset,
+                    f"{msg}, reachable from {path} -- {_REASON}; move it "
+                    "out of the fused step or mark the boundary "
+                    "'# gwlint: allow[fused-dispatch] -- <why>'")
+                continue
+            if _has_allow(sf, node.lineno):
+                continue  # declared boundary at the call site
+            callee = None
+            label = ""
+            if isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                callee = graph.resolve_method(cls, node.func.attr)
+                label = f"self.{node.func.attr}"
+            elif isinstance(node.func, ast.Name):
+                callee = graph.resolve_function(sf.rel, node.func.id)
+                label = node.func.id
+            if callee is not None:
+                queue.append((callee[0], callee[1], f"{path} -> {label}"))

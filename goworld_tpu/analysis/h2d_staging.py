@@ -29,9 +29,18 @@ finding: an ingest-time H2D would ship position data outside
 ops/aoi_stage's sparse-packet layout and double-upload every moved
 entity.
 
+The fused programs (ops/aoi_fused.py) are held to the ingest-grade
+line from the other side: a fused step's packet arrays ride the jit
+call's IMPLICIT H2D (ops/aoi_fused donation discipline), so ANY explicit
+upload call there -- a ``jnp.asarray`` "to be safe", a ``device_put`` of
+a staged array -- either duplicates the transfer or breaks donation,
+and the one-launch steady tick quietly grows a second dispatch.  The
+``*_fused*`` bucket methods around them are already covered by the
+flush/dispatch name filter (``_dispatch_fused`` matches ``_dispatch*``).
+
 Scope: the bucket modules (engine/aoi.py, engine/aoi_mesh.py,
-engine/aoi_rowshard.py) for the flush/dispatch shadow rule; ingest/ for
-the no-device rule.
+engine/aoi_rowshard.py) for the flush/dispatch shadow rule; ingest/ and
+ops/aoi_fused.py for the no-upload rules.
 """
 
 from __future__ import annotations
@@ -44,6 +53,7 @@ RULE = "h2d-staging"
 
 SCOPE = ("engine/aoi.py", "engine/aoi_mesh.py", "engine/aoi_rowshard.py")
 INGEST_SCOPE = ("ingest/",)
+FUSED_SCOPE = ("ops/aoi_fused.py",)
 
 _UPLOAD_NAMES = {"jnp.asarray", "jnp.array", "jax.device_put",
                  "jax.numpy.asarray", "put"}
@@ -85,6 +95,22 @@ def check(ctx: Context):
                 "the device through the delta-staging seam "
                 "(ops/aoi_stage) at the next flush, never at decode "
                 "time; move the upload or mark the line "
+                "'# gwlint: allow[h2d-staging] -- <why>'")
+    # the fused programs: packet arrays ride the jit call's implicit H2D
+    # (donated one-launch discipline) -- an explicit upload duplicates
+    # the transfer or breaks donation
+    for sf in ctx.files_matching(*FUSED_SCOPE):
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call) and _is_upload(node)):
+                continue
+            yield Finding(
+                RULE, sf.rel, node.lineno, node.col_offset,
+                "explicit device upload inside the fused step: packet "
+                "arrays ride the jitted call's implicit H2D under the "
+                "donation discipline (ops/aoi_fused docstring); an "
+                "explicit upload duplicates the transfer or breaks "
+                "donation and the steady tick stops being one launch; "
+                "drop it or mark the line "
                 "'# gwlint: allow[h2d-staging] -- <why>'")
     for sf in ctx.files_matching(*SCOPE):
         for fn in ast.walk(sf.tree):

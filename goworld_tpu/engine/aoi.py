@@ -38,8 +38,11 @@ import numpy as np
 
 from .. import faults, telemetry
 from ..ops import aoi_emit as AE
+from ..ops import aoi_fused as AF
 from ..ops import aoi_pages as PG
 from ..ops import aoi_predicate as P
+from ..ops import aoi_stage as AS
+from ..ops import dispatch_count as DC
 from ..ops.aoi_oracle import CPUAOIOracle
 from ..telemetry import trace as _T
 from ..telemetry.metrics import Sample
@@ -699,8 +702,13 @@ class AOIEngine:
                  rowshard_min_capacity: int = 65536,
                  flush_sched: bool = True, emit: str = "auto",
                  paged: bool = False, cross_tick: bool = False,
-                 interest_mode: str = "device"):
+                 interest_mode: str = "device", fused: bool = False):
         self.default_backend = default_backend
+        # fused steady tick (ops/aoi_fused, ROADMAP #3): each device
+        # bucket compiles its steady-state tick into ONE jitted program
+        # (one enqueue + one D2H fetch); unfused stays the A/B baseline
+        # and the per-tick demotion target for any aoi.* seam fault
+        self.fused = bool(fused)
         # interest-policy stacks (goworld_tpu/interest/): where attached
         # stacks evaluate -- "device" = the fused jitted step, "host" =
         # the CPU oracle (the bit-exact perf baseline bench_engine_interest
@@ -884,7 +892,8 @@ class AOIEngine:
                         capacity, self.mesh, pipeline=self.pipeline,
                         cross_tick=self.cross_tick,
                         delta_staging=self.delta_staging,
-                        emit=self._resolve_emit(), paged=self.paged)
+                        emit=self._resolve_emit(), paged=self.paged,
+                        fused=self.fused)
                     self._rowshard_serial += 1
                     key = (f"tpu-rowshard-{self._rowshard_serial}", capacity)
                 elif self.mesh is not None:
@@ -894,13 +903,15 @@ class AOIEngine:
                         capacity, self.mesh, pipeline=self.pipeline,
                         cross_tick=self.cross_tick,
                         delta_staging=self.delta_staging,
-                        emit=self._resolve_emit(), paged=self.paged)
+                        emit=self._resolve_emit(), paged=self.paged,
+                        fused=self.fused)
                 else:
                     bucket = _TPUBucket(capacity, pipeline=self.pipeline,
                                         cross_tick=self.cross_tick,
                                         delta_staging=self.delta_staging,
                                         emit=self._resolve_emit(),
-                                        paged=self.paged)
+                                        paged=self.paged,
+                                        fused=self.fused)
             else:
                 raise ValueError(f"unknown AOI backend {backend!r}")
             self._buckets[key] = bucket
@@ -931,7 +942,7 @@ class AOIEngine:
                 capacity, self.mesh, pipeline=self.pipeline,
                 cross_tick=self.cross_tick,
                 delta_staging=self.delta_staging, emit=self._resolve_emit(),
-                paged=self.paged)
+                paged=self.paged, fused=self.fused)
             self._rowshard_serial += 1
             self._buckets[(f"tpu-rowshard-{self._rowshard_serial}",
                            capacity)] = bucket
@@ -947,7 +958,8 @@ class AOIEngine:
                     capacity, self.mesh, pipeline=self.pipeline,
                     cross_tick=self.cross_tick,
                     delta_staging=self.delta_staging,
-                    emit=self._resolve_emit(), paged=self.paged)
+                    emit=self._resolve_emit(), paged=self.paged,
+                    fused=self.fused)
                 self._buckets[key] = bucket
         elif tier == "tpu":
             key = (("tpu-single", capacity) if self.mesh is not None
@@ -958,7 +970,8 @@ class AOIEngine:
                                     cross_tick=self.cross_tick,
                                     delta_staging=self.delta_staging,
                                     emit=self._resolve_emit(),
-                                    paged=self.paged)
+                                    paged=self.paged,
+                                    fused=self.fused)
                 self._buckets[key] = bucket
         else:
             raise ValueError(f"unknown placement tier {tier!r}")
@@ -1511,11 +1524,20 @@ class _TPUBucket(_Bucket):
 
     def __init__(self, capacity: int, pipeline: bool = False,
                  delta_staging: bool = True, emit: str = "vector",
-                 paged: bool = False, cross_tick: bool = False):
+                 paged: bool = False, cross_tick: bool = False,
+                 fused: bool = False):
         super().__init__(capacity)
         self.pipeline = pipeline
         self.cross_tick = bool(cross_tick)
         self.delta_staging = delta_staging
+        # fused steady tick (docs/perf.md "Fused tick", ROADMAP #3): when
+        # eligible, the whole dispatch compiles into ONE program
+        # (ops/aoi_fused: scatter + kernel + diff + extraction/paging),
+        # so the steady cost is one enqueue + one D2H fetch.  Unfused is
+        # the A/B baseline and the demotion target: an aoi.* seam firing
+        # in the fused attempt falls through to the unfused flow in the
+        # same call, counted in fused_demotions, bit-exact same-tick.
+        self.fused = bool(fused)
         # paged ragged storage (docs/perf.md paged storage): the change
         # stream compacts into fixed-size pages from an on-device free
         # list (ops/aoi_pages) instead of the capped triples/chunk
@@ -1642,11 +1664,15 @@ class _TPUBucket(_Bucket):
         # page pool could not serve, re-read from the kept change grid and
         # republished same-tick (counted, never silent); page_occupancy =
         # used/total pages at the last harvest (gauge, worst bucket wins)
+        # fused-path additions: fused_dispatches = steady ticks that ran
+        # as one program, fused_demotions = fused attempts a seam fault
+        # demoted to the unfused flow (same call, bit-exact)
         self.stats = {"h2d_bytes": 0, "delta_flushes": 0, "full_flushes": 0,
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
                       "poisoned": 0, "calc_level": 0,
                       "decode_overflow": 0,
                       "page_spills": 0, "page_occupancy": 0.0,
+                      "fused_dispatches": 0, "fused_demotions": 0,
                       "emit_path": AE.EMIT_LEVEL[emit]}
         # phase-attribution counters (seconds, cumulative): stage = host
         # pack + H2D enqueue + dispatch, fetch = synchronous D2H waits,
@@ -1889,6 +1915,7 @@ class _TPUBucket(_Bucket):
         self._rebuild_device()
         if self._pending_reset:
             idx = jnp.asarray(sorted(self._pending_reset), jnp.int32)
+            DC.record()
             self.prev = self.prev.at[idx].set(jnp.uint32(0))
             self._pending_reset.clear()
         if self._pending_clear:
@@ -1914,6 +1941,7 @@ class _TPUBucket(_Bucket):
 
             rows = pad(rows)
             cols = pad(cols)
+            DC.record()
             self.prev = _batched_clear(
                 self.prev,
                 jnp.asarray([s for s, _ in rows], jnp.int32),
@@ -2008,6 +2036,10 @@ class _TPUBucket(_Bucket):
         sub = self._hsub[sl]
         if self._mirror is not None and not sub.all():
             self._mirror_stale.update(s for s in slots if s in self._unsub)
+        if self.fused and self._dispatch_fused(
+                slots, sl, slot_idx, key, scratch, sub, old_x, old_z,
+                old_r, old_act, tri_mode, t_stage0, _ts):
+            return
         self._stage_inputs(sl, old_x, old_z, old_r, old_act)
         _T.lap("aoi.stage", _ts)
         _tk = _T.t()
@@ -2015,6 +2047,7 @@ class _TPUBucket(_Bucket):
         faults.check("aoi.kernel")
         all_unsub = not sub.any()
         if self.paged:
+            DC.record()
             out = _fused_bucket_step_paged(
                 self.prev, *scratch, self._page_free, slot_idx,
                 self._dev["x"], self._dev["z"], self._dev["r"],
@@ -2059,6 +2092,7 @@ class _TPUBucket(_Bucket):
                 self._sched = ("inflight",)
             return
         if tri_mode:
+            DC.record()
             out = _fused_bucket_step_tri(
                 self.prev, *scratch, slot_idx, self._dev["x"],
                 self._dev["z"], self._dev["r"], self._dev["act"],
@@ -2093,6 +2127,7 @@ class _TPUBucket(_Bucket):
             else:
                 self._sched = ("inflight",)
             return
+        DC.record()
         out = _fused_bucket_step(
             self.prev, *scratch, slot_idx, self._dev["x"], self._dev["z"],
             self._dev["r"], self._dev["act"], self._dev["sub"],
@@ -2146,6 +2181,141 @@ class _TPUBucket(_Bucket):
                 self._sched = ("rec", prev_rec)
         else:
             self._sched = ("inflight",)
+
+    def _dispatch_fused(self, slots, sl, slot_idx, key, scratch, sub,
+                        old_x, old_z, old_r, old_act, tri_mode,
+                        t_stage0, _ts) -> bool:
+        """Attempt the ONE-DISPATCH fused tick (ops/aoi_fused, ROADMAP
+        #3): packet scatter + kernel + diff + extraction/paging as a
+        single jitted program, so the steady tick is one enqueue + one
+        D2H fetch.  Returns True when the tick was dispatched fused
+        (the caller's unfused flow is skipped), False to fall through.
+
+        Two distinct False paths, by design:
+
+        * ineligible -- the tick is not a steady delta tick (stale
+          device roles, r/act changed, diff too large, classic host-emit
+          mode, device down): silent fall-through, the unfused path IS
+          the right program for it;
+        * demoted -- an ``aoi.delta``/``aoi.kernel`` seam fault fired in
+          the fused attempt: counted in ``fused_demotions`` and fall
+          through BEFORE any device mutation, so the unfused flow
+          (whose seam occurrence was consumed by the fused attempt)
+          runs clean in the same call -- same-tick, bit-exact.
+        """
+        s_n = len(slots)
+        if not (tri_mode or self.paged):
+            return False  # classic host-emit stream has no fused program
+        if (not self.delta_staging or self._dev_stale
+                or self._calc_level >= 2 or self._need_rebuild):
+            return False
+        if any(role not in self._dev
+               for role in ("x", "z", "r", "act", "sub")):
+            return False
+        new_x, new_z = self._hx[sl], self._hz[sl]
+        if not (np.array_equal(self._hr[sl], old_r)
+                and np.array_equal(self._hact[sl], old_act)):
+            return False  # r/act moved: full-restage tick, unfused
+        diff = (new_x.view(np.uint32) != old_x.view(np.uint32)) \
+            | (new_z.view(np.uint32) != old_z.view(np.uint32))
+        n_changed = np.count_nonzero(diff)
+        if n_changed > self._delta_max_frac * diff.size:
+            return False  # mass movement: full restage beats the scatter
+        try:
+            if n_changed:
+                faults.check("aoi.delta")
+            self._fault_phase = "kernel"
+            faults.check("aoi.kernel")
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self.stats["fused_demotions"] += 1
+            self._fault_phase = "stage"
+            return False
+        if n_changed:
+            rows, cols = np.nonzero(diff)
+            pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
+                                new_z[rows, cols],
+                                page_granular=self.paged)
+            self.stats["h2d_bytes"] += AS.packet_nbytes(*pkt)
+        else:
+            zi = np.zeros(0, np.int32)
+            zf = np.zeros(0, np.float32)
+            pkt = (zi, zi, zf, zf)  # zero movers: in-program no-op scatter
+        self.stats["delta_flushes"] += 1
+        _T.lap("aoi.stage", _ts)
+        _tk = _T.t()
+        all_unsub = not sub.any()
+        platform = "cpu" if self._calc_level >= 1 else None
+        DC.record()
+        if self.paged:
+            bw = PG.bin_words_for(self.W)
+            out = AF.fused_paged_step(
+                self.prev, *scratch, self._page_free, self._dev["x"],
+                self._dev["z"], *pkt, slot_idx, self._dev["r"],
+                self._dev["act"], self._dev["sub"], PG.PAGE_WORDS, bw,
+                PG.MAX_SPILL, platform)
+            (self.prev, new, chg, pg, pc, pn, self._page_free, bundle,
+             self._dev["x"], self._dev["z"]) = out
+            _T.lap("aoi.kernel", _tk)
+            _T.lap("aoi.fused", _tk)
+            if not all_unsub:
+                bundle.copy_to_host_async()
+            rec = {
+                "mode": "paged",
+                "slots": slots, "s_n": s_n, "key": key,
+                "n_pages": self._n_pages, "bin_words": bw,
+                "epochs": [self._slot_epoch.get(s, 0) for s in slots],
+                "scratch": (new, chg, pg, pc, pn),
+                # one compact int32 vector replaces the page_tab /
+                # spill_bins / scalars triple-fetch of the unfused
+                # harvest (_harvest_paged slices it back apart)
+                "bundle": bundle,
+                "page_tab": None, "spill_bins": None, "scalars": None,
+                "all_unsub": all_unsub,
+                "prefetch": None,
+            }
+            if self._defer and not all_unsub:
+                ndp = min(self._n_pages, self._pred_pages)
+                sl_pg = (pg[:ndp], pc[:ndp], pn[:ndp])
+                for a in sl_pg:
+                    a.copy_to_host_async()
+                rec["prefetch"] = (ndp, sl_pg)
+        else:
+            mt = self._max_triples
+            out = AF.fused_tri_step(
+                self.prev, *scratch, self._dev["x"], self._dev["z"],
+                *pkt, slot_idx, self._dev["r"], self._dev["act"],
+                self._dev["sub"], mt, platform)
+            (self.prev, new, chg, tri, scalars,
+             self._dev["x"], self._dev["z"]) = out
+            _T.lap("aoi.kernel", _tk)
+            _T.lap("aoi.fused", _tk)
+            if not all_unsub:
+                scalars.copy_to_host_async()
+            rec = {
+                "mode": "tri",
+                "slots": slots, "s_n": s_n, "key": key, "mt": mt,
+                "epochs": [self._slot_epoch.get(s, 0) for s in slots],
+                "scratch": (new, chg, tri),
+                "scalars": scalars,
+                "all_unsub": all_unsub,
+                "prefetch": None,
+            }
+            if self._defer and not all_unsub:
+                ndp = min(mt, self._pred_tri)
+                sl_tri = tri[:ndp]
+                sl_tri.copy_to_host_async()
+                rec["prefetch"] = (ndp, sl_tri)
+        self.stats["fused_dispatches"] += 1
+        prev_rec, self._inflight = self._inflight, rec
+        self.perf["stage_s"] += time.perf_counter() - t_stage0
+        if self._defer:
+            if prev_rec is not None:
+                self._sched = ("rec", prev_rec)
+        else:
+            self._sched = ("inflight",)
+        return True
 
     def drain(self) -> None:
         """Harvest a pending pipelined tick without dispatching a new one
@@ -2648,8 +2818,17 @@ class _TPUBucket(_Bucket):
         poisoned = False
         n_used = n_spill = 0
         page_spec = page_fault = None
+        bun_h = None
         if not rec.get("all_unsub"):
-            raw = faults.filter("aoi.scalars", np.asarray(rec["scalars"]))
+            if rec.get("bundle") is not None:
+                # fused tick: scalars + page_tab + spill_bins ride ONE
+                # int32 bundle -- a single blocking fetch replaces the
+                # unfused path's three (ops/aoi_fused)
+                bun_h = np.asarray(rec["bundle"])
+                raw = faults.filter("aoi.scalars", bun_h[:4])
+            else:
+                raw = faults.filter("aoi.scalars",
+                                    np.asarray(rec["scalars"]))
             n_used, n_spill, nz_fit, nz_total = (int(v) for v in raw)
             n_bins = -(-nw // bw)
             if not (0 <= n_used <= n_pages and 0 <= n_spill <= n_bins
@@ -2742,7 +2921,8 @@ class _TPUBucket(_Bucket):
             # or truncated id means the free list itself is corrupt -- not
             # a per-tick cap problem -- so the ONLY safe recovery is the
             # full device-state rebuild from the host shadows
-            tab_h = np.asarray(rec["page_tab"])
+            tab_h = (bun_h[4:4 + n_pages] if bun_h is not None
+                     else np.asarray(rec["page_tab"]))
             if page_spec is not None and page_spec.kind == "poison":
                 tab_h = np.full_like(tab_h, np.iinfo(np.int32).min)
             if not PG.validate_page_table(tab_h, n_used, n_pages):
@@ -2762,7 +2942,8 @@ class _TPUBucket(_Bucket):
             # both emit paths sort before expansion -- and the pool grows
             # for the next tick (decay shrinks it back post-storm)
             self.stats["page_spills"] += n_spill
-            sb = np.asarray(rec["spill_bins"])
+            sb = (bun_h[4 + n_pages:] if bun_h is not None
+                  else np.asarray(rec["spill_bins"]))
             sg, sc, sn2 = PG.spill_stream(chg.reshape(-1), new.reshape(-1),
                                           sb, bw, nw)
             gidx = np.concatenate([gidx, sg])

@@ -64,6 +64,7 @@ from .. import faults
 from ..telemetry import trace as _T
 from ..ops import aoi_emit as AE
 from ..ops import aoi_predicate as P
+from ..ops import dispatch_count as DC
 from ..ops import events as EV
 from .aoi import (_Bucket, _CapDecay, _build_snapshot, _device_fault,
                   _emit_expand, _kernelish_fault, _packed_predicate,
@@ -79,8 +80,13 @@ class _MeshTPUBucket(_Bucket):
 
     def __init__(self, capacity: int, mesh, pipeline: bool = False,
                  delta_staging: bool = True, emit: str = "vector",
-                 paged: bool = False, cross_tick: bool = False):
+                 paged: bool = False, cross_tick: bool = False,
+                 fused: bool = False):
         super().__init__(capacity)
+        # fused steady tick (ops/aoi_fused contract, per chip): the
+        # packet scatter folds INTO the sharded step, so a steady tick
+        # is ONE program launch (vs scatter + step); see _dispatch_fused
+        self.fused = bool(fused)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
 
         # paged overflow absorber (docs/perf.md, paged storage): a chip
@@ -169,6 +175,7 @@ class _MeshTPUBucket(_Bucket):
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
                       "poisoned": 0, "calc_level": 0, "decode_overflow": 0,
                       "page_spills": 0, "page_occupancy": 0.0,
+                      "fused_dispatches": 0, "fused_demotions": 0,
                       "emit_path": AE.EMIT_LEVEL[emit]}
         # pipelined tick awaiting harvest
         self._inflight = None
@@ -500,6 +507,7 @@ class _MeshTPUBucket(_Bucket):
         # the col fill must not collide with any real (slot, word) pair --
         # an out-of-bounds word index is dropped by the scatter
         cols = pad(cols, (0, self.W, 0xFFFFFFFF))
+        DC.record()
         self.prev = self._maintenance_fn()(
             self.prev,
             jnp.asarray(resets, jnp.int32),
@@ -566,6 +574,7 @@ class _MeshTPUBucket(_Bucket):
                 pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
                                     new_z[rows, cols],
                                     page_granular=self.paged)
+                DC.record()
                 self._dx, self._dz = self._delta_fn(len(pkt[0]))(
                     self._dx, self._dz, *pkt)
                 self.stats["h2d_bytes"] += AS.packet_nbytes(*pkt)
@@ -590,12 +599,20 @@ class _MeshTPUBucket(_Bucket):
         return dev
 
     # -- the fused dispatch ------------------------------------------------
-    def _sharded_step(self):
+    def _sharded_step(self, npk: int | None = None):
         """Build (or reuse) the jitted shard_map flush for the current
         static config (s_max, caps).  All large outputs ride DONATED scratch
-        buffers (see engine/aoi._fused_bucket_step for why)."""
+        buffers (see engine/aoi._fused_bucket_step for why).
+
+        ``npk`` (fused mode, ops/aoi_fused contract): fold the delta
+        scatter of one replicated packet of that padded length INTO the
+        program -- each chip localizes the row indices to its own block
+        and drops the rest, then steps from the freshly scattered x/z --
+        so the steady tick is ONE launch instead of scatter + step.  The
+        sharded x/z ride as donated inputs and come back as two extra
+        outputs."""
         key = (self.s_max, self._max_chunks, self._kcap, self._max_gaps,
-               self._max_exc, self._calc_level)
+               self._max_exc, self._calc_level, npk)
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
@@ -606,15 +623,19 @@ class _MeshTPUBucket(_Bucket):
         from jax.sharding import PartitionSpec as PS
 
         from ..ops.aoi_dense import aoi_step_chg
+        from ..ops.aoi_stage import delta_scatter
 
         # calculator fallback chain level 1: force the fused dense path
         # even where the platform default would pick Pallas
         platform = "cpu" if self._calc_level >= 1 else self.mesh.platform
         mc, kcap = self._max_chunks, self._kcap
         mg, mx = self._max_gaps, self._max_exc
+        s_local = self.s_max // self.n_dev
+        axis = self.mesh.axis
+        fused = npk is not None
 
-        def _local(prev, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
-                   x, z, r, act, sub):
+        def _body(prev, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
+                  x, z, r, act, sub):
             # platform routing (pallas on TPU, fused dense elsewhere --
             # interpret-mode Pallas walks its grid step-by-step in Python,
             # ~49 s/flush at cap 16384) lives in ops/aoi_dense.aoi_step_chg
@@ -639,15 +660,35 @@ class _MeshTPUBucket(_Bucket):
                     rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
                     exc_new, scalars[None])
 
-        spec = PS(self.mesh.axis)
-        local = shard_map(
-            _local,
-            mesh=self.mesh.mesh,
-            in_specs=(spec,) * 11,
-            out_specs=(spec,) * 14,
-            check_vma=False,
-        )
-        fn = jax.jit(local, donate_argnums=(0, 1, 2, 3, 4, 5))
+        spec, rep = PS(self.mesh.axis), PS()
+        if fused:
+            def _local(prev, chg_buf, vals_buf, nv_buf, lane_buf,
+                       csel_buf, dx, dz, rows, cols, xv, zv, r, act,
+                       sub):
+                lo = jax.lax.axis_index(axis) * s_local
+                dx, dz = delta_scatter(dx, dz, rows, cols, xv, zv,
+                                       row_lo=lo, n_rows=s_local)
+                out = _body(prev, chg_buf, vals_buf, nv_buf, lane_buf,
+                            csel_buf, dx, dz, r, act, sub)
+                return out + (dx, dz)
+
+            local = shard_map(
+                _local,
+                mesh=self.mesh.mesh,
+                in_specs=(spec,) * 8 + (rep,) * 4 + (spec,) * 3,
+                out_specs=(spec,) * 16,
+                check_vma=False,
+            )
+            fn = jax.jit(local, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+        else:
+            local = shard_map(
+                _body,
+                mesh=self.mesh.mesh,
+                in_specs=(spec,) * 11,
+                out_specs=(spec,) * 14,
+                check_vma=False,
+            )
+            fn = jax.jit(local, donate_argnums=(0, 1, 2, 3, 4, 5))
         self._step_cache[key] = fn
         return fn
 
@@ -797,11 +838,16 @@ class _MeshTPUBucket(_Bucket):
             self._mirror_stale.update(
                 s for s in staged_slots if s in self._unsub)
         key, scratch = self._get_scratch()
+        if self.fused and self._dispatch_fused(staged_slots, sl, key,
+                                               scratch, old_x, old_z,
+                                               old_r, old_act, t0, _ts):
+            return
         self._stage_xz(sl, old_x, old_z, old_r, old_act)
         _T.lap("aoi.stage", _ts)
         _tk = _T.t()
         self._fault_phase = "kernel"
         faults.check("aoi.kernel")
+        DC.record()
         out = self._sharded_step()(
             self.prev, *scratch, self._dx, self._dz,
             self._h2d("r", self._hr), self._h2d("act", self._hact),
@@ -863,6 +909,113 @@ class _MeshTPUBucket(_Bucket):
                 self._sched = ("rec", prev_rec)
         else:
             self._sched = ("inflight",)
+
+    def _dispatch_fused(self, staged_slots, sl, key, scratch, old_x,
+                        old_z, old_r, old_act, t0, _ts) -> bool:
+        """Attempt the per-chip fused tick (ops/aoi_fused contract): the
+        packet scatter folds into :meth:`_sharded_step`, making a steady
+        tick ONE program launch instead of delta-scatter + step.  Returns
+        True when dispatched fused; False falls through to the unfused
+        flow -- silently when the tick is simply not a steady delta tick
+        (stale x/z, r/act change, oversized diff), counted in
+        ``fused_demotions`` when an ``aoi.delta``/``aoi.kernel`` seam
+        fault fired in the attempt (the occurrence is consumed, so the
+        unfused flow runs clean in the same call -- same-tick,
+        bit-exact)."""
+        if (not self.delta_staging or self._xz_stale
+                or self._dx is None or self._need_rebuild):
+            return False
+        new_x, new_z = self._hx[sl], self._hz[sl]
+        if not (np.array_equal(self._hr[sl], old_r)
+                and np.array_equal(self._hact[sl], old_act)):
+            return False  # r/act moved: full-restage tick, unfused
+        diff = (new_x.view(np.uint32) != old_x.view(np.uint32)) \
+            | (new_z.view(np.uint32) != old_z.view(np.uint32))
+        n_changed = np.count_nonzero(diff)
+        if n_changed > self._delta_max_frac * max(diff.size, 1):
+            return False  # mass movement: full restage beats the scatter
+        try:
+            if n_changed:
+                faults.check("aoi.delta")
+            self._fault_phase = "kernel"
+            faults.check("aoi.kernel")
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self.stats["fused_demotions"] += 1
+            self._fault_phase = "stage"
+            return False
+        from ..ops import aoi_stage as AS
+
+        if n_changed:
+            rows, cols = np.nonzero(diff)
+            pkt = AS.pad_packet(sl[rows], cols, new_x[rows, cols],
+                                new_z[rows, cols],
+                                page_granular=self.paged)
+            self.stats["h2d_bytes"] += AS.packet_nbytes(*pkt)
+        else:
+            zi = np.zeros(0, np.int32)
+            zf = np.zeros(0, np.float32)
+            pkt = (zi, zi, zf, zf)  # zero movers: in-program no-op scatter
+        self.stats["delta_flushes"] += 1
+        _T.lap("aoi.stage", _ts)
+        _tk = _T.t()
+        DC.record()
+        out = self._sharded_step(len(pkt[0]))(
+            self.prev, *scratch, self._dx, self._dz, *pkt,
+            self._h2d("r", self._hr), self._h2d("act", self._hact),
+            self._h2d("sub", self._hsub))
+        (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos,
+         woff, esc_rows, exc_gidx, exc_chg, exc_new, scalars,
+         self._dx, self._dz) = out
+        _T.lap("aoi.kernel", _tk)
+        _T.lap("aoi.fused", _tk)
+        self.prev = new
+        all_unsub = bool(self._unsub) and all(s in self._unsub
+                                              for s in staged_slots)
+        if not all_unsub:
+            scalars.copy_to_host_async()
+        rec = {
+            "slots": staged_slots,
+            "epochs": {s: self._slot_epoch.get(s, 0)
+                       for s in range(self.s_max)},
+            "key": key, "caps": (self._max_chunks, self._kcap,
+                                 self._max_gaps, self._max_exc),
+            "scratch": (chg, g_vals, g_nv, g_lane, g_csel),
+            "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+                        exc_new),
+            "scalars": scalars,
+            "all_unsub": all_unsub,
+            "prefetch": None,
+        }
+        if self._defer and not all_unsub:
+            mc = self._max_chunks
+            ndp = min(mc, self._pred[0])
+            escp = min(self._max_gaps, self._pred[1])
+            excp = min(self._max_exc, self._pred[2])
+            slices = []
+            for d in range(self.n_dev):
+                slices.append((
+                    rowb[d * mc:d * mc + ndp],
+                    bitpos[d * mc:d * mc + ndp],
+                    woff[d * mc:d * mc + ndp],
+                    esc_rows[d * self._max_gaps:d * self._max_gaps + escp],
+                    exc_gidx[d * self._max_exc:d * self._max_exc + excp],
+                    exc_chg[d * self._max_exc:d * self._max_exc + excp],
+                    exc_new[d * self._max_exc:d * self._max_exc + excp],
+                ))
+                for a in slices[-1]:
+                    a.copy_to_host_async()
+            rec["prefetch"] = (ndp, escp, excp, slices)
+        self.stats["fused_dispatches"] += 1
+        prev_rec, self._inflight = self._inflight, rec
+        self.perf["stage_s"] += time.perf_counter() - t0
+        if self._defer:
+            if prev_rec is not None:
+                self._sched = ("rec", prev_rec)
+        else:
+            self._sched = ("inflight",)
+        return True
 
     def drain(self) -> None:
         self.harvest()

@@ -13,9 +13,9 @@ bucket.
 
 The reference's answer to an oversized space is capacity-capping and
 splitting (/root/reference/examples/unity_demo/SpaceService.go:91-109) plus a
-pluggable-AOI seam meant to scale (/root/reference/engine/entity/Space.go:106,
-TODO.md:19); this supersedes both: one logical space, n chips, bit-exact
-events.
+pluggable-AOI seam meant to scale (/root/reference/engine/entity/Space.go:106;
+see ROADMAP.md for the scaling north-star); this supersedes both: one logical
+space, n chips, bit-exact events.
 
 Design notes:
   * One bucket instance per space (``exclusive``): the engine keys it
@@ -49,6 +49,7 @@ import numpy as np
 from .. import faults
 from ..telemetry import trace as _T
 from ..ops import aoi_predicate as P
+from ..ops import dispatch_count as DC
 from ..ops import events as EV
 from ..ops import aoi_emit as AE
 from .aoi import (_Bucket, _CapDecay, _build_snapshot, _device_fault,
@@ -66,8 +67,14 @@ class _RowShardTPUBucket(_Bucket):
 
     def __init__(self, capacity: int, mesh, pipeline: bool = False,
                  delta_staging: bool = True, emit: str = "vector",
-                 paged: bool = False, cross_tick: bool = False):
+                 paged: bool = False, cross_tick: bool = False,
+                 fused: bool = False):
         super().__init__(capacity)
+        # fused steady tick (ops/aoi_fused contract, per chip): both
+        # packet scatters (sharded block + replicated candidates) fold
+        # INTO the rectangular step, so a steady tick is ONE program
+        # launch (vs scatter + step); see _dispatch_fused
+        self.fused = bool(fused)
         import jax  # noqa: F401  (fail fast if jax is unavailable)
 
         # paged overflow absorber (docs/perf.md, paged storage): a chip
@@ -147,6 +154,7 @@ class _RowShardTPUBucket(_Bucket):
                       "rebuilds": 0, "fallbacks": 0, "host_ticks": 0,
                       "poisoned": 0, "calc_level": 0, "decode_overflow": 0,
                       "page_spills": 0, "page_occupancy": 0.0,
+                      "fused_dispatches": 0, "fused_demotions": 0,
                       "emit_path": AE.EMIT_LEVEL[emit]}
         self._pred = (512, 64, 256)
         self.full_roundtrips = 0
@@ -250,6 +258,7 @@ class _RowShardTPUBucket(_Bucket):
                 _, cols, xv, zv = AS.pad_packet(cols, cols, self._hx[cols],
                                                 self._hz[cols],
                                                 page_granular=self.paged)
+                DC.record()
                 self._dxs, self._dzs, self._dxr, self._dzr = \
                     self._delta_fn(len(cols))(
                         self._dxs, self._dzs, self._dxr, self._dzr,
@@ -277,9 +286,18 @@ class _RowShardTPUBucket(_Bucket):
                 self.stats["h2d_bytes"] += src.nbytes
                 self._host_prev = None
 
-    def _sharded_step(self):
+    def _sharded_step(self, npk: int | None = None):
+        """Jitted shard_map rectangular step for the current static caps.
+
+        ``npk`` (fused mode, ops/aoi_fused contract): fold the delta
+        scatter of one replicated (cols, xv, zv) packet of that padded
+        length into the program -- each chip scatters its own column
+        block plus its replicated candidate copy, then steps from the
+        freshly scattered x/z -- so a steady tick is ONE launch instead
+        of scatter + step.  The four device x/z copies ride as donated
+        inputs and come back as extra outputs."""
         key = (self._max_chunks, self._kcap, self._max_gaps, self._max_exc,
-               self._calc_level)
+               self._calc_level, npk)
         fn = self._step_cache.get(key)
         if fn is not None:
             return fn
@@ -290,6 +308,7 @@ class _RowShardTPUBucket(_Bucket):
         from jax.sharding import PartitionSpec as PS
 
         from ..ops.aoi_dense import aoi_step_chg
+        from ..ops.aoi_stage import delta_scatter_1d
 
         # calculator fallback chain level 1: force the fused dense path
         platform = "cpu" if self._calc_level >= 1 else self.mesh.platform
@@ -297,9 +316,10 @@ class _RowShardTPUBucket(_Bucket):
         mg, mx = self._max_gaps, self._max_exc
         cl = self.c_local
         axis = self.mesh.axis
+        fused = npk is not None
 
-        def _local(prev_blk, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
-                   xs, zs, rs, acts, x_all, z_all, act_all, sub):
+        def _body(prev_blk, chg_buf, vals_buf, nv_buf, lane_buf, csel_buf,
+                  xs, zs, rs, acts, x_all, z_all, act_all, sub):
             lo = jax.lax.axis_index(axis) * cl
             rid = (lo + jnp.arange(cl, dtype=jnp.int32))[None]
             # platform routing lives in ops/aoi_dense.aoi_step_chg
@@ -329,14 +349,37 @@ class _RowShardTPUBucket(_Bucket):
 
         spec = PS(self.mesh.axis)
         rep = PS()
-        local = shard_map(
-            _local,
-            mesh=self.mesh.mesh,
-            in_specs=(spec,) * 10 + (rep, rep, rep, rep),
-            out_specs=(spec,) * 14,
-            check_vma=False,
-        )
-        fn = jax.jit(local, donate_argnums=(0, 1, 2, 3, 4, 5))
+        if fused:
+            def _local(prev_blk, chg_buf, vals_buf, nv_buf, lane_buf,
+                       csel_buf, xs, zs, rs, acts, xr, zr, act_all, sub,
+                       cols, xv, zv):
+                lo = jax.lax.axis_index(axis) * cl
+                xs, zs = delta_scatter_1d(xs, zs, cols, xv, zv,
+                                          col_lo=lo, n_cols=cl)
+                xr, zr = delta_scatter_1d(xr, zr, cols, xv, zv)
+                out = _body(prev_blk, chg_buf, vals_buf, nv_buf, lane_buf,
+                            csel_buf, xs, zs, rs, acts, xr, zr, act_all,
+                            sub)
+                return out + (xs, zs, xr, zr)
+
+            local = shard_map(
+                _local,
+                mesh=self.mesh.mesh,
+                in_specs=(spec,) * 10 + (rep,) * 7,
+                out_specs=(spec,) * 16 + (rep, rep),
+                check_vma=False,
+            )
+            fn = jax.jit(local,
+                         donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 10, 11))
+        else:
+            local = shard_map(
+                _body,
+                mesh=self.mesh.mesh,
+                in_specs=(spec,) * 10 + (rep, rep, rep, rep),
+                out_specs=(spec,) * 14,
+                check_vma=False,
+            )
+            fn = jax.jit(local, donate_argnums=(0, 1, 2, 3, 4, 5))
         self._step_cache[key] = fn
         return fn
 
@@ -425,6 +468,7 @@ class _RowShardTPUBucket(_Bucket):
 
         rows = pad(ents, self.capacity)        # OOB row -> dropped
         cols = pad(cols, (self.W, 0xFFFFFFFF))  # OOB word -> dropped
+        DC.record()
         self.prev = self._maintenance_fn()(
             self.prev,
             jnp.asarray(rows, jnp.int32),
@@ -535,6 +579,9 @@ class _RowShardTPUBucket(_Bucket):
         old_x, old_z, old_r, old_act = self._cur_old
         self._ensure_prev()
         key, scratch = self._get_scratch()
+        if self.fused and self._dispatch_fused(key, scratch, old_x, old_z,
+                                               old_r, old_act, t0, _ts):
+            return
         self._stage_xz(old_x, old_z, old_r, old_act)
         # np.array (not asarray): a host python bool, no device sync here
         sub = self._h2d("sub", np.array(self._subscribed), replicated=True)
@@ -542,6 +589,7 @@ class _RowShardTPUBucket(_Bucket):
         _tk = _T.t()
         self._fault_phase = "kernel"
         faults.check("aoi.kernel")
+        DC.record()
         out = self._sharded_step()(
             self.prev, *scratch,
             self._dxs, self._dzs,
@@ -589,6 +637,106 @@ class _RowShardTPUBucket(_Bucket):
             "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
                         exc_new),
             "scalars": scalars, "prefetch": pf})
+
+    def _dispatch_fused(self, key, scratch, old_x, old_z, old_r, old_act,
+                        t0, _ts) -> bool:
+        """One-launch steady tick (ops/aoi_fused contract, per chip): the
+        packet scatter of all four device x/z copies folds into the
+        rectangular step program, so a steady tick is one enqueue per
+        chip instead of scatter + step.  Returns False -- silently on an
+        ineligible tick (full restage pending, r/act change, oversized
+        delta), counted in ``fused_demotions`` on a seam demotion -- and
+        _dispatch_device continues down the unfused path in the same
+        call, bit-exact."""
+        if (not self.delta_staging or self._xz_stale
+                or self._dxs is None):
+            return False
+        if not (np.array_equal(self._hr, old_r)
+                and np.array_equal(self._hact, old_act)):
+            return False  # r/act change: unfused full-restage fallback
+        diff = (self._hx.view(np.uint32) != old_x.view(np.uint32)) \
+            | (self._hz.view(np.uint32) != old_z.view(np.uint32))
+        n_changed = np.count_nonzero(diff)  # host numpy scalar
+        if n_changed > self._delta_max_frac * diff.size:
+            return False
+        # the unfused path's staging + kernel seams, checked up front --
+        # BEFORE any device mutation -- so a seam firing mid-"program"
+        # demotes cleanly: the unfused retry re-runs from the exact same
+        # pre-tick device state
+        try:
+            if n_changed:
+                faults.check("aoi.delta")
+            self._fault_phase = "kernel"
+            faults.check("aoi.kernel")
+        except Exception as e:
+            if not _device_fault(e):
+                raise
+            self.stats["fused_demotions"] += 1
+            self._fault_phase = "stage"
+            return False
+        from ..ops import aoi_stage as AS
+
+        if n_changed:
+            cols = np.nonzero(diff)[0]
+            _, cols, xv, zv = AS.pad_packet(cols, cols, self._hx[cols],
+                                            self._hz[cols],
+                                            page_granular=self.paged)
+            self.stats["h2d_bytes"] += cols.nbytes + xv.nbytes + zv.nbytes
+        else:
+            # zero movers: a shape-(0,) packet keeps the scatter an
+            # in-program no-op under its own (bounded) compile key
+            cols = np.zeros(0, np.int32)
+            xv = zv = np.zeros(0, np.float32)
+        self.stats["delta_flushes"] += 1
+        sub = self._h2d("sub", np.array(self._subscribed), replicated=True)
+        _T.lap("aoi.stage", _ts)
+        _tk = _T.t()
+        DC.record()
+        out = self._sharded_step(len(cols))(
+            self.prev, *scratch,
+            self._dxs, self._dzs,
+            self._h2d("r", self._hr), self._h2d("act", self._hact),
+            self._dxr, self._dzr,
+            self._h2d("act_all", self._hact, replicated=True),
+            sub, cols, xv, zv)
+        (new, chg, g_vals, g_nv, g_lane, g_csel, rowb, bitpos, woff,
+         esc_rows, exc_gidx, exc_chg, exc_new, scalars,
+         self._dxs, self._dzs, self._dxr, self._dzr) = out
+        _T.lap("aoi.kernel", _tk)
+        _T.lap("aoi.fused", _tk)
+        self.prev = new
+        scalars.copy_to_host_async()
+        pf = None
+        if self._subscribed:
+            mc = self._max_chunks
+            ndp = min(mc, self._pred[0])
+            escp = min(self._max_gaps, self._pred[1])
+            excp = min(self._max_exc, self._pred[2])
+            slices = []
+            for d in range(self.n_dev):
+                sl = (rowb[d * mc:d * mc + ndp],
+                      bitpos[d * mc:d * mc + ndp],
+                      woff[d * mc:d * mc + ndp],
+                      esc_rows[d * self._max_gaps:
+                               d * self._max_gaps + escp],
+                      exc_gidx[d * self._max_exc:d * self._max_exc + excp],
+                      exc_chg[d * self._max_exc:d * self._max_exc + excp],
+                      exc_new[d * self._max_exc:d * self._max_exc + excp])
+                for a in sl:
+                    a.copy_to_host_async()
+                slices.append(sl)
+            pf = (ndp, escp, excp, slices)
+        self.stats["fused_dispatches"] += 1
+        self.perf["stage_s"] += time.perf_counter() - t0
+        self._sched = ("rec", {
+            "caps": (self._max_chunks, self._kcap, self._max_gaps,
+                     self._max_exc),
+            "key": key,
+            "scratch": (chg, g_vals, g_nv, g_lane, g_csel),
+            "streams": (rowb, bitpos, woff, esc_rows, exc_gidx, exc_chg,
+                        exc_new),
+            "scalars": scalars, "prefetch": pf})
+        return True
 
     def _harvest(self, rec) -> None:  # gwlint: allow[host-sync] -- THE per-tick drain point: harvests kernel outputs once per flush
         c = self.capacity

@@ -53,6 +53,7 @@ class Runtime:
         aoi_emit: str = "auto",
         aoi_paged: bool = False,
         aoi_cross_tick: bool = False,
+        aoi_fused: bool = False,
         aoi_interest: str = "device",
         aoi_placement: str = "static",
         aoi_migration_threshold_ms: float = 5.0,
@@ -88,6 +89,7 @@ class Runtime:
                              rowshard_min_capacity=aoi_rowshard_min_capacity,
                              flush_sched=aoi_flush_sched, emit=aoi_emit,
                              paged=aoi_paged, cross_tick=aoi_cross_tick,
+                             fused=aoi_fused,
                              interest_mode=aoi_interest)
         # telemetry-driven placement (engine/placement.py): "static" keeps
         # spaces where capacity routing put them (migrate() stays available

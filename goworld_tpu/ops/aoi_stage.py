@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import dispatch_count as DC
 from ..telemetry import trace as _T
 
 _MIN_PACKET = 64
@@ -131,6 +132,7 @@ def apply_packet(dx, dz, rows, cols, xv, zv):
 
         _apply_impl = impl
     _th = _T.t()
+    DC.record()
     out = _apply_impl(dx, dz, rows, cols, xv, zv)
     _T.lap("aoi.h2d", _th)
     return out

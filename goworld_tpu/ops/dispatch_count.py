@@ -1,0 +1,44 @@
+"""Test-visible counter of XLA program launches (device dispatches).
+
+The fused-tick contract (ROADMAP #3, docs/perf.md "Fused tick") is "one
+enqueue + one D2H fetch per steady-state tick" -- and a contract nobody
+can measure is a contract that silently rots.  Every engine call site
+that launches a compiled XLA program (delta scatter, bucket step,
+maintenance scatter, fused step, sharded step) reports here via
+:func:`record`, and tests/test_fused.py plus scripts/fused_smoke.py
+bracket a tick with :func:`read` to pin the count: 1 for a fused
+single-chip bucket, 2 for the unfused delta-staged path (scatter +
+step), and the documented per-chip program counts for the sharded
+tiers.
+
+Counting is launch-side (did the host enqueue a program), not
+device-side (XLA may still fuse or cache internally) -- that is exactly
+the host-overhead boundary the fused tick exists to cross fewer times.
+Transfers (``jnp.asarray`` uploads, ``copy_to_host_async``) are NOT
+dispatches and are tracked separately as ``aoi.h2d_bytes``.
+
+Pure host-side integers: importing this module never loads jax, and
+recording is a plain increment, so the counter is safe inside
+``dispatch()`` (the gwlint flush-phase rule walks through it).
+"""
+
+from __future__ import annotations
+
+_n = 0
+
+
+def record(n=1):
+    """Count ``n`` XLA program launches (call beside the jitted call)."""
+    global _n
+    _n += n
+
+
+def read():
+    """Total launches recorded since the last :func:`reset`."""
+    return _n
+
+
+def reset():
+    """Zero the counter (test/smoke harness hook)."""
+    global _n
+    _n = 0

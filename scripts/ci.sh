@@ -83,7 +83,14 @@ JAX_PLATFORMS=cpu python scripts/interest_smoke.py || fail=1
 echo "== loadgen smoke =="
 JAX_PLATFORMS=cpu python scripts/loadgen_smoke.py || fail=1
 
-# 13. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+# 13. fused-dispatch smoke (CPU backend, 8 virtual devices): 3-tier
+#    fused-vs-unfused-vs-oracle parity, device dispatches per steady tick
+#    (fused must hit 1), forced mid-run aoi.kernel fault demotion
+#    republishing same-tick (docs/perf.md "Fused dispatch")
+echo "== fused smoke =="
+JAX_PLATFORMS=cpu python scripts/fused_smoke.py || fail=1
+
+# 14. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
 #    over every declared seam, bit-exact parity + zero stuck buckets
 #    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
 if [ "${GW_SOAK:-0}" = "1" ]; then
@@ -94,7 +101,7 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 14. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 15. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

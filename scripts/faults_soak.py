@@ -13,6 +13,8 @@ is the whole self-healing story at once, seams interacting:
   (``reset_calc_chain``/``reset_emit_path``; demotion is deliberately
   sticky) plus two clean ticks puts every bucket back at
   ``calc_level == 0`` with no pending repair and parity intact;
+* the fused one-launch pipeline (``aoi_fused``) demotes per-tick when a
+  seam fires inside the attempt -- counted, bit-exact, self-re-engaging;
 * the connection seams get the same treatment against a live socket:
   injected resets on flush/connect must still deliver every payload
   exactly once, in order, with the outage buffer drained.
@@ -141,6 +143,67 @@ def soak_aoi(seed: int, cap=256, n=200, ticks=10, cross_tick=False) -> dict:
         st = dict(h.bucket.stats)
         assert st["calc_level"] == 0, f"stuck bucket seed={seed}: {st}"
         return {"fired": len(plan.fired), "stats": st}
+    finally:
+        faults.clear()
+
+
+def soak_fused(seed: int, cap=256, n=200, ticks=10) -> dict:
+    """The ``aoi.fused`` round: a fused paged engine
+    (``Runtime(aoi_fused=True)`` routing, docs/perf.md "Fused dispatch")
+    walks next to the uninjected CPU oracle under seam specs PINNED at
+    mid-walk occurrences (the soak_ingest idiom: provably fired every
+    round).  A seam firing inside the one-launch fused attempt must
+    demote exactly that tick to the unfused path -- counted in
+    ``fused_demotions``, republished same-tick, bit-exact -- and the
+    fused path must re-engage on its own (demotion is per-tick, not
+    sticky).  Movement is SPARSE (~15%/tick): a full-world move is
+    silently fused-ineligible by design (delta > ``_delta_max_frac``)
+    and would soak nothing."""
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(0, 600, cap).astype(np.float32)
+    z = rng.uniform(0, 600, cap).astype(np.float32)
+    r = rng.uniform(60, 120, cap).astype(np.float32)
+    act = np.zeros(cap, bool)
+    act[:n] = True
+
+    oracle = AOIEngine(default_backend="cpu")
+    oh = oracle.create_space(cap)
+    plan = faults.FaultPlan(seed=seed)
+    for seam in ("aoi.kernel", "aoi.delta"):
+        kinds = AOI_SEAM_KINDS[seam]
+        plan.add(seam, kinds[int(rng.integers(len(kinds)))],
+                 at=int(rng.integers(3, ticks)))
+    faults.install(plan)
+    try:
+        eng = AOIEngine(default_backend="tpu", paged=True, fused=True)
+        h = eng.create_space(cap)
+        for t in range(ticks + 2):
+            if t == ticks:  # plan exhausted or not: operator re-arm
+                faults.clear()
+            move = rng.random(cap) < 0.15
+            x[move] = np.clip(x[move] + rng.uniform(
+                -20, 20, int(move.sum())), 0, 600).astype(np.float32)
+            z[move] = np.clip(z[move] + rng.uniform(
+                -20, 20, int(move.sum())), 0, 600).astype(np.float32)
+            eng.submit(h, x, z, r, act)
+            oracle.submit(oh, x, z, r, act)
+            eng.flush()
+            oracle.flush()
+            e, l = eng.take_events(h)
+            ce, cl = oracle.take_events(oh)
+            np.testing.assert_array_equal(e, ce,
+                                          err_msg=f"enter t={t} seed={seed}")
+            np.testing.assert_array_equal(l, cl,
+                                          err_msg=f"leave t={t} seed={seed}")
+        st = dict(h.bucket.stats)
+        assert st["fused_dispatches"] > 0, \
+            f"fused path never engaged seed={seed}: {st}"
+        assert st["fused_demotions"] >= 1, \
+            f"pinned seam never demoted the fused attempt seed={seed}: {st}"
+        assert st["calc_level"] == 0, f"stuck bucket seed={seed}: {st}"
+        return {"fired": len(plan.fired),
+                "fused": st["fused_dispatches"],
+                "demoted": st["fused_demotions"]}
     finally:
         faults.clear()
 
@@ -485,6 +548,7 @@ def main(argv):
         # the sequential bucket and the aoi_paged x aoi_cross_tick combo
         xt = bool(i % 2)
         a = soak_aoi(seed, cross_tick=xt)
+        f = soak_fused(seed)
         g = soak_ingest(seed)
         it = soak_interest(seed)
         c = soak_checkpoint(seed)
@@ -494,6 +558,7 @@ def main(argv):
               f"aoi fired={a['fired']} rebuilds={a['stats']['rebuilds']} "
               f"host_ticks={a['stats']['host_ticks']} "
               f"page_spills={a['stats']['page_spills']} | "
+              f"fused n={f['fused']} demoted={f['demoted']} | "
               f"ingest {g['kind']} demoted={g['demoted']} "
               f"batched={g['batched']} | "
               f"interest {it['kind']}@{it['at']} "
@@ -502,8 +567,8 @@ def main(argv):
               f"torn={c['torn']} | "
               f"disp fired={d['fired']} replayed={d['replayed']} -- "
               f"bit-exact, no stuck buckets")
-    print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.ingest, "
-          f"aoi.interest and store.*, parity held)")
+    print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.fused "
+          f"demotion, aoi.ingest, aoi.interest and store.*, parity held)")
     return 0
 
 

@@ -17,8 +17,8 @@ import textwrap
 from pathlib import Path
 
 from goworld_tpu.analysis import coverage, determinism, dtypes, \
-    fault_seams, flush_phase, h2d_staging, host_sync, oracle_parity, \
-    telemetry_rule, wire_protocol
+    fault_seams, flush_phase, fused_dispatch, h2d_staging, host_sync, \
+    oracle_parity, telemetry_rule, wire_protocol
 from goworld_tpu.analysis.__main__ import main as gwlint_main
 from goworld_tpu.analysis.core import run
 
@@ -504,6 +504,72 @@ def test_flush_phase_out_of_scope_files_untouched(tmp_path):
     _mk(tmp_path, {"ops/x.py": DISPATCH})
     findings, _ = _run(tmp_path, [flush_phase.check])
     assert findings == []
+
+
+# -- fused-dispatch -----------------------------------------------------------
+
+FUSED_PROG = """\
+    import numpy as np
+
+    def fused_tri_step(x):
+        n = int(x.sum())
+        return n
+
+    def _build_impl():
+        return np.asarray
+"""
+
+FUSED_BUCKET = """\
+    import numpy as np
+
+    class Bucket:
+        def _dispatch_fused(self, key):
+            self._seams()
+            return self._enqueue_fused(key)
+
+        def _enqueue_fused(self, key):
+            return self._count.item()
+
+        def _seams(self):  # gwlint: allow[fused-dispatch] -- fixture seam boundary
+            return np.asarray(self._hx)
+
+        def harvest(self):
+            return np.asarray(self.prev)
+"""
+
+
+def test_fused_dispatch_walks_fused_entry_points(tmp_path):
+    """Every module function of ops/aoi_fused.py and every *_fused*
+    bucket method is an entry; syncs they reach are flagged, declared
+    boundaries stop the walk, and non-fused methods (harvest) are out
+    of scope for THIS rule."""
+    _mk(tmp_path, {"ops/aoi_fused.py": FUSED_PROG,
+                   "engine/aoi.py": FUSED_BUCKET})
+    findings, _ = _run(tmp_path, [fused_dispatch.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {
+        ("ops/aoi_fused.py", _ln(FUSED_PROG, "int(x.sum())")),
+        ("engine/aoi.py", _ln(FUSED_BUCKET, "self._count.item()")),
+    }
+    assert all(f.rule == "fused-dispatch" for f in findings)
+    assert any("Bucket._dispatch_fused" in f.message
+               and "self._enqueue_fused" in f.message for f in findings)
+
+
+def test_fused_dispatch_out_of_scope_files_untouched(tmp_path):
+    _mk(tmp_path, {"ops/other.py": FUSED_PROG,
+                   "engine/runtime.py": FUSED_BUCKET})
+    findings, _ = _run(tmp_path, [fused_dispatch.check])
+    assert findings == []
+
+
+def test_flush_phase_walks_fused_programs_too(tmp_path):
+    """ops/aoi_fused.py module functions are dispatch-phase code: the
+    flush-phase walk covers them as its third entry-point set."""
+    _mk(tmp_path, {"ops/aoi_fused.py": FUSED_PROG})
+    findings, _ = _run(tmp_path, [flush_phase.check])
+    got = {(f.path, f.line) for f in findings}
+    assert got == {("ops/aoi_fused.py", _ln(FUSED_PROG, "int(x.sum())"))}
 
 
 # -- fault-seam-coverage -----------------------------------------------------
