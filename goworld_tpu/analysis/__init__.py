@@ -1,7 +1,8 @@
 """gwlint: repo-specific static analysis for goworld_tpu.
 
-Run as ``python -m goworld_tpu.analysis <paths>``.  Twelve checkers, each
-an AST pass over the tree (stdlib-only -- no jax import needed):
+Run as ``python -m goworld_tpu.analysis <paths>``.  Fifteen checkers,
+each an AST pass over a shared per-run :class:`~.index.ProjectIndex`
+(stdlib-only -- no jax import needed):
 
 ===================  =====================================================
 rule                 invariant
@@ -25,31 +26,45 @@ bounded-caps         cap-shaped device buffers carry a counted overflow
                      fallback (no silent fixed-cap truncation)
 oracle-parity        every registered InterestPolicy declares a CPU
                      oracle and is referenced from tests/
+recompile-churn      jit/pallas_call construction is memoized, closures
+                     don't capture recompile-forcing Python scalars, and
+                     static args stay low-cardinality
+thread-discipline    attributes written on a background thread and read
+                     on the foreground path reference a lock/queue/event
+msg-flow             every MT_* constant has a sender, a handler, and a
+                     dispatcher route (or band pass-through)
 ===================  =====================================================
 
-See docs/static-analysis.md for the suppression story.
+``RULES`` maps rule name -> checker; ``CHECKERS`` preserves the ordered
+list form.  See docs/static-analysis.md for the suppression story.
 """
 
 from __future__ import annotations
 
 from . import (bounded_caps, coverage, determinism, dtypes, fault_seams,
                flush_phase, fused_dispatch, h2d_staging, host_sync,
-               oracle_parity, telemetry_rule, wire_protocol)
+               msg_flow, oracle_parity, recompile_churn, telemetry_rule,
+               thread_discipline, wire_protocol)
 from .core import Context, Finding, Suppressions, run
 
-CHECKERS = [
-    host_sync.check,
-    dtypes.check,
-    wire_protocol.check,
-    determinism.check,
-    coverage.check,
-    h2d_staging.check,
-    fault_seams.check,
-    telemetry_rule.check,
-    flush_phase.check,
-    fused_dispatch.check,
-    bounded_caps.check,
-    oracle_parity.check,
-]
+RULES = {
+    host_sync.RULE: host_sync.check,
+    dtypes.RULE: dtypes.check,
+    wire_protocol.RULE: wire_protocol.check,
+    determinism.RULE: determinism.check,
+    coverage.RULE: coverage.check,
+    h2d_staging.RULE: h2d_staging.check,
+    fault_seams.RULE: fault_seams.check,
+    telemetry_rule.RULE: telemetry_rule.check,
+    flush_phase.RULE: flush_phase.check,
+    fused_dispatch.RULE: fused_dispatch.check,
+    bounded_caps.RULE: bounded_caps.check,
+    oracle_parity.RULE: oracle_parity.check,
+    recompile_churn.RULE: recompile_churn.check,
+    thread_discipline.RULE: thread_discipline.check,
+    msg_flow.RULE: msg_flow.check,
+}
 
-__all__ = ["CHECKERS", "Context", "Finding", "Suppressions", "run"]
+CHECKERS = list(RULES.values())
+
+__all__ = ["CHECKERS", "RULES", "Context", "Finding", "Suppressions", "run"]

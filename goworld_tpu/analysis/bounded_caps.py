@@ -71,7 +71,7 @@ def _has_counted_fallback(fn: ast.AST) -> bool:
 
 def check(ctx: Context):
     for sf in ctx.files_matching(*SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.Call) \
                     or call_name(node) not in _ALLOC or not node.args:
                 continue

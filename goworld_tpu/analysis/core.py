@@ -35,6 +35,10 @@ import tokenize
 
 _ALLOW_RE = re.compile(r"#\s*gwlint:\s*allow\[([a-z0-9_,\- ]+)\]")
 
+# every ast.parse rides SourceFile.__init__; --profile prints this to
+# prove the 15-rule run parses each file exactly once
+PARSE_COUNT = {"n": 0}
+
 
 @dataclasses.dataclass
 class Finding:
@@ -56,9 +60,13 @@ class SourceFile:
         self.abspath = abspath
         self.rel = rel.replace(os.sep, "/")
         self.text = text
+        PARSE_COUNT["n"] += 1
         self.tree = ast.parse(text, filename=rel)
+        # every node, BFS order -- checkers iterate this instead of
+        # re-walking the tree (ast.walk dominates a 15-rule run otherwise)
+        self.nodes: list[ast.AST] = list(ast.walk(self.tree))
         self.parents: dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(self.tree):
+        for parent in self.nodes:
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
         # line -> set of allowed rules ("*" = all)
@@ -157,6 +165,16 @@ class Context:
         self.root = root
         self.tests_dir = tests_dir
         self._tests_text: str | None = None
+        self._tests_idents: set[str] | None = None
+        self._index = None
+
+    @property
+    def index(self):
+        """The shared ProjectIndex, built lazily ONCE per run."""
+        if self._index is None:
+            from .index import ProjectIndex
+            self._index = ProjectIndex(self.files)
+        return self._index
 
     def files_matching(self, *suffixes: str) -> list[SourceFile]:
         """Files whose rel path ends with (or contains a dir named by) any
@@ -191,6 +209,12 @@ class Context:
         return self._tests_text
 
     def tests_reference(self, symbol: str) -> bool:
+        if symbol.isidentifier():
+            # one tokenization pays for every identifier lookup
+            if self._tests_idents is None:
+                self._tests_idents = set(
+                    re.findall(r"[A-Za-z_][A-Za-z0-9_]*", self.tests_text()))
+            return symbol in self._tests_idents
         return re.search(
             r"(?<![A-Za-z0-9_])" + re.escape(symbol) + r"(?![A-Za-z0-9_])",
             self.tests_text()) is not None
@@ -242,11 +266,24 @@ def find_repo_root(start: str) -> str:
         cur = nxt
 
 
+def _rule_name(checker) -> str:
+    mod = sys.modules.get(getattr(checker, "__module__", ""), None)
+    return getattr(mod, "RULE", getattr(checker, "__name__", "?"))
+
+
 def run(paths: list[str], *, root: str | None = None,
         tests_dir: str | None = None, suppressions: str | None = None,
-        checkers=None) -> tuple[list[Finding], list[str]]:
-    """Run every checker; returns (findings, config_errors)."""
-    from . import CHECKERS
+        checkers=None, profile: dict | None = None,
+        only_files: set[str] | None = None) -> tuple[list[Finding], list[str]]:
+    """Run every checker; returns (findings, config_errors).
+
+    ``profile`` (a dict the caller owns) is filled with per-rule wall
+    times plus the parse ledger: ``{"rules": [(rule, secs)], "files": n,
+    "parses": n}`` -- parses == files is the parse-once contract.
+    ``only_files`` (rel paths) filters FINDINGS, not the scan: whole-
+    program rules still see the full tree (--changed-only).
+    """
+    import time
 
     if root is None:
         root = find_repo_root(paths[0])
@@ -257,11 +294,18 @@ def run(paths: list[str], *, root: str | None = None,
         cand = os.path.join(root, "gwlint.suppressions")
         suppressions = cand if os.path.exists(cand) else None
     sup = Suppressions.load(suppressions)
+    parses0 = PARSE_COUNT["n"]
     files = collect_files(paths, root)
     ctx = Context(files, root, tests_dir)
     findings: list[Finding] = []
+    from . import CHECKERS
     for checker in (checkers if checkers is not None else CHECKERS):
-        for f in checker(ctx):
+        t0 = time.perf_counter()
+        batch = list(checker(ctx))
+        if profile is not None:
+            profile.setdefault("rules", []).append(
+                (_rule_name(checker), time.perf_counter() - t0))
+        for f in batch:
             sf = next((s for s in files if s.rel == f.path), None)
             if sf is not None:
                 if not f.symbol:
@@ -271,7 +315,12 @@ def run(paths: list[str], *, root: str | None = None,
                     continue
             if sup.covers(f):
                 continue
+            if only_files is not None and f.path not in only_files:
+                continue
             findings.append(f)
+    if profile is not None:
+        profile["files"] = len(files)
+        profile["parses"] = PARSE_COUNT["n"] - parses0
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, sup.errors
 

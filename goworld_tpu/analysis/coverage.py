@@ -45,7 +45,7 @@ def check(ctx: Context):
     if ctx.tests_dir is None:
         return
     for sf in ctx.files:
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if isinstance(node, ast.IfExp):
                 thr = _threshold_gated(node.test)
                 if thr is None:

@@ -64,7 +64,7 @@ def _builds_wire_bytes(loop: ast.For) -> bool:
 
 def check(ctx: Context):
     for sf in ctx.files_matching(*SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.For):
                 continue
             it = node.iter

@@ -63,7 +63,7 @@ def _is_kernel(fn: ast.AST) -> bool:
 
 def check(ctx: Context):
     for sf in ctx.files_matching(*SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if isinstance(node, ast.Call):
                 name = call_name(node)
                 pos = _CONSTRUCTORS.get(name)
@@ -81,7 +81,7 @@ def check(ctx: Context):
                             f".astype({arg.id}) uses a python builtin: width "
                             "is platform-dependent; use an explicit jnp dtype")
         # bare float literals inside kernel bodies
-        for fn in ast.walk(sf.tree):
+        for fn in sf.nodes:
             if not _is_kernel(fn):
                 continue
             for node in ast.walk(fn):

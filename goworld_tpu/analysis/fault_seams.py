@@ -46,7 +46,7 @@ FAMILIES = {
 def _declared_seams(sf) -> dict[str, int]:
     """SEAMS dict string keys -> declaration line, from faults.py."""
     out: dict[str, int] = {}
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
             if "SEAMS" in targets:
@@ -122,7 +122,7 @@ def check(ctx: Context):
     for sf in ctx.files:
         if sf is cat_sf or sf.rel.startswith("tests/"):
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if isinstance(node, ast.Call):
                 seam = _seam_arg(node)
                 if seam is None:
@@ -191,7 +191,7 @@ def check(ctx: Context):
         if not (base == "aoi.py" or base.startswith("aoi_")) \
                 or "engine" not in sf.rel or sf.rel.startswith("tests/"):
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.ClassDef):
                 continue
             defined = {n.name for n in node.body

@@ -6,10 +6,11 @@ is pure enqueue: every bucket's dispatch runs before the FIRST blocking
 fetch, so one stray ``np.asarray`` / ``.item()`` / ``block_until_ready``
 inside a dispatch body serializes the whole tick back to
 flush-per-bucket -- silently, with nothing crashing and the scheduler
-spans still printing.  This rule walks the static call graph from each
-bucket tier's ``dispatch()`` (``self.X`` resolved through the class, its
-bases -- ``_Bucket`` lives in engine/aoi.py -- and module functions) and
-flags any host-sync call it can reach.
+spans still printing.  This rule walks the shared ProjectIndex call
+graph (index.py) from each bucket tier's ``dispatch()`` (``self.X``
+resolved through the class and its MRO -- ``_Bucket`` lives in
+engine/aoi.py -- plus bare and module-alias calls through the import
+table) and flags any host-sync call it can reach.
 
 Boundaries are explicit: a call line or callee ``def`` line carrying
 ``# gwlint: allow[flush-phase] -- <why>`` stops the traversal there (the
@@ -38,8 +39,8 @@ from __future__ import annotations
 
 import ast
 
-from .core import Context, Finding, SourceFile, call_name
-from .host_sync import _SYNC_ATTRS, _SYNC_CALLS
+from .core import Context
+from .index import walk_no_sync
 
 RULE = "flush-phase"
 
@@ -59,147 +60,42 @@ _EMIT_REASON = ("harvest emit helpers run on already-fetched arrays and "
 _FUSED_REASON = ("the fused step is dispatch-phase code -- one enqueue, "
                  "one async fetch (docs/perf.md 'Fused dispatch')")
 
-
-def _sync_msg(node: ast.Call) -> str | None:
-    """The host_sync detection, verbatim (one taxonomy, two rules)."""
-    name = call_name(node)
-    if name in _SYNC_CALLS:
-        return _SYNC_CALLS[name]
-    if isinstance(node.func, ast.Attribute) and node.func.attr in _SYNC_ATTRS:
-        verb = ("forces a device sync" if node.func.attr == "block_until_ready"
-                else "is a scalar D2H fetch")
-        return f".{node.func.attr}() {verb}"
-    if name in ("float", "int") and len(node.args) == 1 \
-            and not node.keywords \
-            and not isinstance(node.args[0], ast.Constant):
-        return f"{name}() on a possibly-device value is a scalar D2H fetch"
-    return None
-
-
-class _Graph:
-    """Method/function tables over every scoped file, for self.X lookup."""
-
-    def __init__(self, files: list[SourceFile]):
-        # class name -> (base names, {method name: (node, sf)})
-        self.classes: dict[str, tuple[list[str], dict]] = {}
-        # bare function name -> (node, sf); per file, module level only
-        self.mod_funcs: dict[str, dict] = {}
-        for sf in files:
-            funcs = self.mod_funcs.setdefault(sf.rel, {})
-            for node in sf.tree.body:
-                if isinstance(node, ast.ClassDef):
-                    bases = [b.id for b in node.bases
-                             if isinstance(b, ast.Name)]
-                    methods = {
-                        m.name: (m, sf) for m in node.body
-                        if isinstance(m, (ast.FunctionDef,
-                                          ast.AsyncFunctionDef))}
-                    self.classes[node.name] = (bases, methods)
-                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    funcs[node.name] = (node, sf)
-
-    def resolve_method(self, cls: str, name: str):
-        """(node, sf) for cls.name, searching bases depth-first by name --
-        mesh/rowshard import their bases from engine/aoi.py, so bare base
-        names resolve across files."""
-        seen = set()
-        stack = [cls]
-        while stack:
-            c = stack.pop(0)
-            if c in seen or c not in self.classes:
-                continue
-            seen.add(c)
-            bases, methods = self.classes[c]
-            if name in methods:
-                return methods[name]
-            stack.extend(bases)
-        return None
-
-    def resolve_function(self, rel: str, name: str):
-        hit = self.mod_funcs.get(rel, {}).get(name)
-        if hit is not None:
-            return hit
-        for funcs in self.mod_funcs.values():
-            if name in funcs:
-                return funcs[name]
-        return None
-
-
-def _has_allow(sf: SourceFile, line: int) -> bool:
-    rules = sf.allow.get(line)
-    return bool(rules) and (RULE in rules or "*" in rules)
+_HINT = "move it out of the walked phase"
 
 
 def check(ctx: Context):
-    files = ctx.files_matching(*FUSED_SCOPE)
-    graph = _Graph(files)
-    for sf in files:
+    index = ctx.index
+    for sf in ctx.files_matching(*FUSED_SCOPE):
         if sf.rel.endswith("ops/aoi_fused.py"):
             # every fused program is dispatch-phase: pure enqueue
-            for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
-                yield from _walk(graph, "", name, fn, fsf, _FUSED_REASON)
+            for name, (fn, fsf) in index.mod_funcs.get(sf.rel, {}).items():
+                yield from walk_no_sync(index, RULE, _FUSED_REASON, _HINT,
+                                        "", name, fn, fsf)
             continue
-        emit_layer = sf.rel.endswith("ops/aoi_emit.py")
-        if emit_layer:
+        if sf.rel.endswith("ops/aoi_emit.py"):
             # every module function of the emit layer is an entry point
-            for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
-                yield from _walk(graph, "", name, fn, fsf, _EMIT_REASON)
+            for name, (fn, fsf) in index.mod_funcs.get(sf.rel, {}).items():
+                yield from walk_no_sync(index, RULE, _EMIT_REASON, _HINT,
+                                        "", name, fn, fsf)
             continue
         for cls in sf.tree.body:
             if not isinstance(cls, ast.ClassDef):
                 continue
-            methods = graph.classes.get(cls.name, ([], {}))[1]
-            entry = methods.get("dispatch")
+            ci = index.classes_by_rel.get(sf.rel, {}).get(cls.name)
+            if ci is None:
+                continue
+            entry = ci.methods.get("dispatch")
             if entry is not None and entry[1] is sf:
                 # inherited default (host-only tiers) is inline-ok
-                yield from _walk(graph, cls.name, "dispatch", *entry,
-                                 _DISPATCH_REASON)
-            for name, m_entry in methods.items():
+                yield from walk_no_sync(index, RULE, _DISPATCH_REASON, _HINT,
+                                        cls.name, "dispatch", *entry)
+            for name, m_entry in ci.methods.items():
                 if m_entry[1] is sf and (name.startswith("_publish")
                                          or name.startswith("_emit")):
-                    yield from _walk(graph, cls.name, name, *m_entry,
-                                     _EMIT_REASON)
+                    yield from walk_no_sync(index, RULE, _EMIT_REASON, _HINT,
+                                            cls.name, name, *m_entry)
         # module-level emit helpers (shared across the bucket tiers)
-        for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
+        for name, (fn, fsf) in index.mod_funcs.get(sf.rel, {}).items():
             if name.startswith("_emit"):
-                yield from _walk(graph, "", name, fn, fsf, _EMIT_REASON)
-
-
-def _walk(graph: _Graph, cls: str, entry_name: str, entry_node, entry_sf,
-          reason: str = _DISPATCH_REASON):
-    # BFS over (function node, its file, display path from the entry)
-    visited: set[tuple[str, int]] = set()
-    display = f"{cls}.{entry_name}" if cls else entry_name
-    queue = [(entry_node, entry_sf, display)]
-    while queue:
-        fn, sf, path = queue.pop(0)
-        key = (sf.rel, fn.lineno)
-        if key in visited:
-            continue
-        visited.add(key)
-        if _has_allow(sf, fn.lineno):
-            continue  # whole callee is a declared boundary
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            msg = _sync_msg(node)
-            if msg is not None:
-                yield Finding(
-                    RULE, sf.rel, node.lineno, node.col_offset,
-                    f"{msg}, reachable from {path} -- {reason}; move it "
-                    "out of the walked phase or mark the boundary "
-                    "'# gwlint: allow[flush-phase] -- <why>'")
-                continue
-            if _has_allow(sf, node.lineno):
-                continue  # declared boundary at the call site
-            callee = None
-            if isinstance(node.func, ast.Attribute) \
-                    and isinstance(node.func.value, ast.Name) \
-                    and node.func.value.id == "self":
-                callee = graph.resolve_method(cls, node.func.attr)
-                label = f"self.{node.func.attr}"
-            elif isinstance(node.func, ast.Name):
-                callee = graph.resolve_function(sf.rel, node.func.id)
-                label = node.func.id
-            if callee is not None:
-                queue.append((callee[0], callee[1], f"{path} -> {label}"))
+                yield from walk_no_sync(index, RULE, _EMIT_REASON, _HINT,
+                                        "", name, fn, fsf)

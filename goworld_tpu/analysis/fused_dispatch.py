@@ -13,8 +13,8 @@ delete.  Worse than the flush-phase failure mode, it also hides in the
 A/B: the fused row keeps winning on dispatch COUNT while losing the
 wall-clock it was built to reclaim.
 
-Entry points walked (the flush-phase call-graph machinery, one taxonomy
-shared with host-sync):
+Entry points walked (the shared ProjectIndex call graph -- index.py --
+one sync taxonomy shared with host-sync and flush-phase):
 
 * every module function of ops/aoi_fused.py (the fused programs and
   their lazy impl builders);
@@ -33,8 +33,8 @@ from __future__ import annotations
 
 import ast
 
-from .core import Context, Finding, SourceFile
-from .flush_phase import _Graph, _sync_msg
+from .core import Context
+from .index import walk_no_sync
 
 RULE = "fused-dispatch"
 
@@ -46,63 +46,25 @@ _REASON = ("the fused step is one enqueue + one async fetch (docs/perf.md "
            "fusion exists to overlap")
 
 
-def _has_allow(sf: SourceFile, line: int) -> bool:
-    rules = sf.allow.get(line)
-    return bool(rules) and (RULE in rules or "*" in rules)
+_HINT = "move it out of the fused step"
 
 
 def check(ctx: Context):
-    files = ctx.files_matching(*SCOPE)
-    graph = _Graph(files)
-    for sf in files:
+    index = ctx.index
+    for sf in ctx.files_matching(*SCOPE):
         if sf.rel.endswith("ops/aoi_fused.py"):
             # every fused program (module function) is an entry point
-            for name, (fn, fsf) in graph.mod_funcs.get(sf.rel, {}).items():
-                yield from _walk(graph, "", name, fn, fsf)
+            for name, (fn, fsf) in index.mod_funcs.get(sf.rel, {}).items():
+                yield from walk_no_sync(index, RULE, _REASON, _HINT,
+                                        "", name, fn, fsf)
             continue
         for cls in sf.tree.body:
             if not isinstance(cls, ast.ClassDef):
                 continue
-            for name, (m, msf) in graph.classes.get(
-                    cls.name, ([], {}))[1].items():
+            ci = index.classes_by_rel.get(sf.rel, {}).get(cls.name)
+            if ci is None:
+                continue
+            for name, (m, msf) in ci.methods.items():
                 if msf is sf and "_fused" in name:
-                    yield from _walk(graph, cls.name, name, m, msf)
-
-
-def _walk(graph: _Graph, cls: str, entry_name: str, entry_node, entry_sf):
-    visited: set[tuple[str, int]] = set()
-    display = f"{cls}.{entry_name}" if cls else entry_name
-    queue = [(entry_node, entry_sf, display)]
-    while queue:
-        fn, sf, path = queue.pop(0)
-        key = (sf.rel, fn.lineno)
-        if key in visited:
-            continue
-        visited.add(key)
-        if _has_allow(sf, fn.lineno):
-            continue  # whole callee is a declared boundary
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            msg = _sync_msg(node)
-            if msg is not None:
-                yield Finding(
-                    RULE, sf.rel, node.lineno, node.col_offset,
-                    f"{msg}, reachable from {path} -- {_REASON}; move it "
-                    "out of the fused step or mark the boundary "
-                    "'# gwlint: allow[fused-dispatch] -- <why>'")
-                continue
-            if _has_allow(sf, node.lineno):
-                continue  # declared boundary at the call site
-            callee = None
-            label = ""
-            if isinstance(node.func, ast.Attribute) \
-                    and isinstance(node.func.value, ast.Name) \
-                    and node.func.value.id == "self":
-                callee = graph.resolve_method(cls, node.func.attr)
-                label = f"self.{node.func.attr}"
-            elif isinstance(node.func, ast.Name):
-                callee = graph.resolve_function(sf.rel, node.func.id)
-                label = node.func.id
-            if callee is not None:
-                queue.append((callee[0], callee[1], f"{path} -> {label}"))
+                    yield from walk_no_sync(index, RULE, _REASON, _HINT,
+                                            cls.name, name, m, msf)

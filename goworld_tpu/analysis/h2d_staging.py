@@ -85,7 +85,7 @@ def check(ctx: Context):
     # seam (position data reaches the device only via ops/aoi_stage's
     # sparse packets at the next flush)
     for sf in ctx.files_matching(*INGEST_SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not (isinstance(node, ast.Call) and _is_upload(node)):
                 continue
             yield Finding(
@@ -100,7 +100,7 @@ def check(ctx: Context):
     # (donated one-launch discipline) -- an explicit upload duplicates
     # the transfer or breaks donation
     for sf in ctx.files_matching(*FUSED_SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not (isinstance(node, ast.Call) and _is_upload(node)):
                 continue
             yield Finding(
@@ -113,7 +113,7 @@ def check(ctx: Context):
                 "drop it or mark the line "
                 "'# gwlint: allow[h2d-staging] -- <why>'")
     for sf in ctx.files_matching(*SCOPE):
-        for fn in ast.walk(sf.tree):
+        for fn in sf.nodes:
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                     or not (fn.name in ("flush", "dispatch")
                             or fn.name.startswith("_flush")

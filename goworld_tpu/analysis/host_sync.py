@@ -36,7 +36,7 @@ _SYNC_CALLS = {
 
 def check(ctx: Context):
     for sf in ctx.files_matching(*SCOPE):
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.Call):
                 continue
             name = call_name(node)

@@ -76,7 +76,7 @@ def check(ctx: Context):
     for sf in ctx.files_matching("interest/"):
         if sf.rel.startswith("tests/"):
             continue
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.ClassDef) or node.name == _BASE:
                 continue
             registered = _decorated_register(node)

@@ -1,0 +1,341 @@
+"""recompile-churn: jit hazards that force retraces at megabatch scale.
+
+Space-stacked megabatching (ROADMAP open item #2) lives or dies on how
+often XLA retraces: one jit program shared across spaces is the plan,
+one retrace per space per tick is the failure mode -- and nothing
+crashes when it happens, the tick just quietly pays compile time.  The
+hazards are all visible statically (via the ProjectIndex jit/pallas
+site table):
+
+* ``jax.jit`` / ``pl.pallas_call`` constructed inside a function (or
+  loop) body with NO memoization: a fresh wrapper has a fresh trace
+  cache, so every call retraces.  The tree's sanctioned idioms are
+  recognized as memo evidence -- the compiled fn (or a decorated inner
+  def) escaping into a ``global``-declared name, a ``self.X``
+  attribute, or a keyed cache subscript (``self._step_cache[key] =
+  fn``); construction inside an already-jitted function is traced
+  once with its parent and also fine.
+* closure-captured Python scalars where an argument belongs: a
+  non-memoized inner def that bakes enclosing locals into the trace
+  recompiles whenever they change (reported with the captured names).
+* high-cardinality static args: ``static_argnums``/``static_argnames``
+  naming per-tick / per-entity values (tick, seed, eid, counts)
+  compiles one program per distinct value.
+* shape-dependent Python ``if``/``while`` on a traced parameter: the
+  branch burns into the trace -- it either retraces per shape bucket or
+  raises at trace time; ``lax.cond``/``jnp.where`` (or declaring the
+  parameter static) is the fix.  ``x.shape``/``x.dtype`` attribute
+  tests, ``len(x)``, ``is None`` checks and ``isinstance`` are static
+  and stay clean.
+
+Scope: the whole scanned tree (jit construction only happens in ops/
+and engine/ today; the rule keeps the next subsystem honest too).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding, SourceFile, call_name, dotted
+
+RULE = "recompile-churn"
+
+_CONSTRUCTORS = {"jit", "pallas_call"}
+_HIGH_CARD_RE = re.compile(
+    r"(?:^|_)(tick|seed|frame|epoch|time|eid|uid)(?:$|_)"
+    r"|count|n_entit|entity_id|client_id|space_id")
+
+
+def _last(name: str) -> str:
+    return name.rsplit(".", 1)[-1]
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """@jax.jit / @jit / @functools.partial(jax.jit, ...) / @jax.jit(...)"""
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        return _last(dotted(dec)) in _CONSTRUCTORS
+    if isinstance(dec, ast.Call):
+        fn = dotted(dec.func)
+        if _last(fn) in _CONSTRUCTORS:
+            return True
+        if _last(fn) == "partial" and dec.args \
+                and _last(dotted(dec.args[0])) in _CONSTRUCTORS:
+            return True
+    return False
+
+
+def _static_names(call_kwargs, params: list[str]) -> set[str]:
+    """Static arg names from a jit call's keywords (+ argnums -> params)."""
+    out: set[str] = set()
+    for kw in call_kwargs:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                        and not isinstance(n.value, bool) \
+                        and 0 <= n.value < len(params):
+                    out.add(params[n.value])
+    return out
+
+
+def _params(fn) -> list[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _enclosing_defs(sf: SourceFile, node: ast.AST) -> list:
+    """Innermost-first chain of defs containing ``node``."""
+    out = []
+    cur = sf.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(cur)
+        cur = sf.parents.get(cur)
+    return out
+
+
+def _assigned_names(fn) -> set[str]:
+    out = set()
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+    return out
+
+
+def _jit_aliases_and_escape(outer, sites: list[ast.AST]) -> tuple[set, bool]:
+    """Names in ``outer`` bound (transitively) to a jit construction from
+    ``sites`` (calls and jit-decorated inner defs), and whether any such
+    value escapes into a global-declared name, attribute, or subscript --
+    the memoization evidence."""
+    declared = set()
+    for n in ast.walk(outer):
+        if isinstance(n, (ast.Global, ast.Nonlocal)):
+            declared.update(n.names)
+    aliases = {d.name for d in sites
+               if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    calls = [s for s in sites if isinstance(s, ast.Call)]
+
+    def _is_jit_value(expr) -> bool:
+        return expr in calls or (
+            isinstance(expr, ast.Name) and expr.id in aliases)
+
+    escaped = False
+    for _ in range(3):  # tiny fixpoint: alias chains are 1-2 hops deep
+        changed = False
+        for n in ast.walk(outer):
+            if isinstance(n, ast.Return) and n.value is not None \
+                    and _is_jit_value(n.value):
+                # a factory returning the compiled fn hands memoization to
+                # the caller (make_* idiom); returning jit(f)(x) -- the
+                # INVOCATION -- is not a return of the wrapper and still
+                # flags
+                escaped = True
+            elif isinstance(n, ast.Assign) and _is_jit_value(n.value):
+                for t in n.targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        escaped = True
+                    elif isinstance(t, ast.Name):
+                        if t.id in declared:
+                            escaped = True
+                        elif t.id not in aliases:
+                            aliases.add(t.id)
+                            changed = True
+            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                # cache.setdefault(key, fn) / self._warm(fn): handing the
+                # compiled fn to a container or helper counts as memoized
+                if any(_is_jit_value(a) for a in n.args) \
+                        or any(_is_jit_value(kw.value) for kw in n.keywords):
+                    escaped = True
+        if not changed:
+            break
+    return aliases, escaped
+
+
+def _captured_scalars(inner, outer) -> list[str]:
+    """Enclosing-scope names an inner def bakes into its trace."""
+    own = set(_params(inner)) | {a.arg for a in inner.args.kwonlyargs}
+    own |= _assigned_names(inner)
+    outer_locals = set(_params(outer)) | _assigned_names(outer)
+    captured = set()
+    for n in ast.walk(inner):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id not in own and n.id in outer_locals:
+            captured.add(n.id)
+    return sorted(captured)
+
+
+def check(ctx: Context):
+    index = ctx.index
+    # -- construction inside a function/loop without memoization ------------
+    by_outer: dict[tuple, list] = {}  # (sf, outermost def) -> sites
+    for site in index.jit_sites:
+        if site.kind not in _CONSTRUCTORS:
+            continue
+        chain = _enclosing_defs(site.sf, site.node)
+        if not chain:
+            continue  # module level: the sanctioned home
+        if any(_is_jit_decorator(d)
+               for fn in chain for d in fn.decorator_list):
+            continue  # constructed while tracing its jitted parent
+        by_outer.setdefault((site.sf, chain[-1]), []).append(site.node)
+    # jit-DECORATED inner defs are construction sites too (the lazy
+    # @partial(jax.jit, ...) builder idiom); jit_sites can't see bare
+    # @jax.jit decorators, so collect them per file here
+    for sf in ctx.files:
+        for node in sf.nodes:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_jit_decorator(d) for d in node.decorator_list):
+                continue
+            chain = _enclosing_defs(sf, node)
+            if not chain:
+                continue
+            if any(_is_jit_decorator(d)
+                   for fn in chain for d in fn.decorator_list):
+                continue
+            by_outer.setdefault((sf, chain[-1]), []).append(node)
+
+    for (sf, outer), sites in by_outer.items():
+        aliases, escaped = _jit_aliases_and_escape(outer, sites)
+        if escaped:
+            continue
+        for site in sites:
+            in_loop = False
+            cur = sf.parents.get(site)
+            while cur is not None and cur is not outer:
+                if isinstance(cur, (ast.For, ast.While)):
+                    in_loop = True
+                cur = sf.parents.get(cur)
+            where = "a loop in " if in_loop else ""
+            if isinstance(site, ast.Call):
+                what = call_name(site) or _last(dotted(site.func))
+                inner = site.args[0] if site.args else None
+                if isinstance(inner, ast.Name):
+                    inner = next(
+                        (n for n in ast.walk(outer)
+                         if isinstance(n, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                         and n.name == inner.id), None)
+            else:
+                what = f"@jit def {site.name}"
+                inner = site
+            captured = (_captured_scalars(inner, outer)
+                        if isinstance(inner, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)) else [])
+            cap = (f" (closure-captures {', '.join(captured)} -- per-space "
+                   "values belong in arguments or a cache key)"
+                   if captured else "")
+            yield Finding(
+                RULE, sf.rel, site.lineno, site.col_offset,
+                f"{what} constructed inside {where}{outer.name}() with no "
+                "memoization: a fresh wrapper retraces on every call"
+                f"{cap}; hoist it to module level or store the compiled "
+                "fn in a global/attribute/keyed cache")
+
+    # -- static-arg cardinality + traced-if, per jitted def ------------------
+    for sf in ctx.files:
+        for node in sf.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) and _is_jit_decorator(dec):
+                        statics = _static_names(dec.keywords, _params(node))
+                        yield from _check_statics(sf, dec, statics)
+                        yield from _check_traced_if(sf, node, statics)
+                    elif _is_jit_decorator(dec):
+                        yield from _check_traced_if(sf, node, set())
+            elif isinstance(node, ast.Call) \
+                    and _last(call_name(node)) in _CONSTRUCTORS \
+                    and node.args:
+                inner = node.args[0]
+                if isinstance(inner, ast.Name):
+                    inner = _local_def(sf, node, inner.id)
+                if isinstance(inner, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    statics = _static_names(node.keywords, _params(inner))
+                    yield from _check_statics(sf, node, statics)
+                    yield from _check_traced_if(sf, inner, statics)
+                else:
+                    yield from _check_statics(sf, node, set())
+
+
+def _local_def(sf: SourceFile, at: ast.AST, name: str):
+    """The def ``name`` visible from ``at``: enclosing scope, then module."""
+    for outer in _enclosing_defs(sf, at):
+        for n in ast.walk(outer):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and n.name == name:
+                return n
+    for n in sf.tree.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name == name:
+            return n
+    return None
+
+
+def _check_statics(sf: SourceFile, call, statics: set[str]):
+    for name in sorted(statics):
+        if _HIGH_CARD_RE.search(name):
+            yield Finding(
+                RULE, sf.rel, call.lineno, call.col_offset,
+                f"static arg '{name}' looks per-tick/per-entity: every "
+                "distinct value compiles a fresh program (one retrace per "
+                "space per tick at megabatch scale); pass it traced, or "
+                "bucket it to a bounded set of values")
+
+
+def _check_traced_if(sf: SourceFile, fn, statics: set[str]):
+    traced = set(_params(fn)) - statics - {"self"}
+    if not traced:
+        return
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        hits = _traced_names_in_test(sf, node.test, traced)
+        for name in sorted(hits):
+            yield Finding(
+                RULE, sf.rel, node.lineno, node.col_offset,
+                f"python branch on traced parameter '{name}' inside jitted "
+                f"{fn.name}(): the condition burns into the trace -- it "
+                "retraces per value bucket or fails at trace time; use "
+                f"lax.cond/jnp.where, or declare '{name}' in "
+                "static_argnames if it is genuinely low-cardinality")
+
+
+def _traced_names_in_test(sf: SourceFile, test: ast.AST,
+                          traced: set[str]) -> set[str]:
+    # identity / type checks are python-level and trace-stable
+    if isinstance(test, ast.Compare) \
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return set()
+    if isinstance(test, ast.Call) \
+            and _last(call_name(test)) in ("isinstance", "callable",
+                                           "hasattr", "len"):
+        return set()
+    if isinstance(test, ast.BoolOp):
+        out: set[str] = set()
+        for v in test.values:
+            out |= _traced_names_in_test(sf, v, traced)
+        return out
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _traced_names_in_test(sf, test.operand, traced)
+    out = set()
+    for n in ast.walk(test):
+        if not (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+                and n.id in traced):
+            continue
+        parent = sf.parents.get(n)
+        # x.shape / x.ndim / x.dtype tests are static; len(x) too
+        if isinstance(parent, ast.Attribute) and parent.value is n:
+            continue
+        if isinstance(parent, ast.Call) and isinstance(parent.func, ast.Name) \
+                and parent.func.id in ("len", "isinstance", "type"):
+            continue
+        # x is None / x is not None guards (optional args)
+        if isinstance(parent, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in parent.ops):
+            continue
+        out.add(n.id)
+    return out

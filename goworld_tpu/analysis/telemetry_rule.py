@@ -106,7 +106,7 @@ def check(ctx: Context):
                             "package: import it lazily (the "
                             "enable_jax_annotations seam) so telemetry "
                             "never drags in a device runtime")
-        for node in ast.walk(sf.tree):
+        for node in sf.nodes:
             if not isinstance(node, ast.Call):
                 continue
             if in_pkg and isinstance(node.func, ast.Attribute) \

@@ -62,7 +62,7 @@ def _packet_codecs(sf):
                 and isinstance(node.value, ast.Call) \
                 and call_name(node.value).endswith("Struct"):
             struct_names.add(node.targets[0].id)
-    for node in ast.walk(sf.tree):
+    for node in sf.nodes:
         if not isinstance(node, ast.ClassDef):
             continue
         for item in node.body:
@@ -94,7 +94,7 @@ def _sender_streams(sf):
     A sender is any function whose body calls ``*.for_msgtype(<MT attr>)``.
     """
     out = []
-    for fn in ast.walk(sf.tree):
+    for fn in sf.nodes:
         if not isinstance(fn, ast.FunctionDef):
             continue
         mt_name = None

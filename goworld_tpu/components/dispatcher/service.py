@@ -226,6 +226,12 @@ class DispatcherService:
             gi.frozen = False
             self._unblock_game(gi)
         self.log.info("game%d connected (%d entities, restore=%s)", gid, n, is_restore)
+        # announce the (re)connected game to its peers -- the twin of the
+        # MT_NOTIFY_GAME_DISCONNECTED broadcast in _on_disconnect, so a
+        # game sees both edges of a neighbor's availability
+        ann = Packet.for_msgtype(MT.MT_NOTIFY_GAME_CONNECTED)
+        ann.append_u16(gid)
+        self._broadcast_games(ann, exclude=gid)
         # srvdis snapshot: a (re)connecting game must learn registrations it
         # missed AND drop stale ones purged while it was away (its provider
         # entry may have been released to another game) -- sent even when

@@ -521,6 +521,10 @@ class GameService:
         finally:
             self._suppress_notify_eids.discard(eid)
 
+    def _h_game_connected(self, pkt):
+        gid = pkt.read_u16()
+        self.log.info("peer game%d connected", gid)
+
     def _h_game_disconnected(self, pkt):
         gid = pkt.read_u16()
         self.log.info("peer game%d disconnected", gid)
@@ -555,6 +559,7 @@ class GameService:
         MT.MT_MIGRATE_REQUEST: _h_migrate_request_ack,
         MT.MT_REAL_MIGRATE: _h_real_migrate,
         MT.MT_REJECT_DUPLICATE_ENTITY: _h_reject_duplicate_entity,
+        MT.MT_NOTIFY_GAME_CONNECTED: _h_game_connected,
         MT.MT_NOTIFY_GAME_DISCONNECTED: _h_game_disconnected,
         MT.MT_NOTIFY_GATE_DISCONNECTED: _h_gate_disconnected,
         MT.MT_START_FREEZE_GAME_ACK: _h_freeze_ack,

@@ -354,7 +354,7 @@ def expand_words_host(vals, flat_idx, capacity: int, n_spaces: int):  # gwlint: 
     return _sorted_pairs(s, i, j, capacity)
 
 
-def expand_classified_host(chg_vals, ent_vals, flat_idx, capacity: int,  # gwlint: allow[host-sync] -- host-side expansion of the drained stream
+def expand_classified_host(chg_vals, ent_vals, flat_idx, capacity: int,  # gwlint: allow[host-sync,flush-phase] -- host-side expansion of the drained stream: harvest feeds it decoded values after the fetch
                            n_spaces: int):
     """One-pass expansion of a classified change stream.
 

@@ -29,7 +29,8 @@ MT_LOAD_ENTITY_ANYWHERE = 21    # game -> disp: type, eid
 MT_CALL_ENTITY_METHOD = 22      # any game -> disp -> owner game
 MT_CALL_ENTITY_METHOD_FROM_CLIENT = 23  # client -> gate -> disp -> game
 MT_CALL_NIL_SPACES = 24         # broadcast to all games' nil spaces
-MT_QUERY_SPACE_GAMEID = 25      # for CreateEntityInSpace etc.
+# id 25 retired (was MT_QUERY_SPACE_GAMEID, never implemented -- msg-flow);
+# migration uses MT_QUERY_SPACE_GAMEID_FOR_MIGRATE.  Do not reuse the id.
 MT_CALL_ENTITIES_BATCH = 26     # game -> disp -> games: one RPC, many eids
                                 # (grouped fanout: pubsub publish etc.)
 

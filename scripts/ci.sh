@@ -101,7 +101,29 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 15. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 15. native fan-out under ASan/UBSan -- opt-in (GW_SANITIZE=1): rebuild
+#    the .san.so variants and re-run the emit-path smoke with the
+#    sanitizer runtimes preloaded (same env recipe as
+#    tests/test_native_sanitize.py; docs/perf.md emit paths)
+if [ "${GW_SANITIZE:-0}" = "1" ]; then
+    echo "== emit smoke (ASan/UBSan) =="
+    if make -C native -s sanitize; then
+        asan="$(g++ -print-file-name=libasan.so)"
+        ubsan="$(g++ -print-file-name=libubsan.so)"
+        GW_SANITIZED_NATIVE=1 JAX_PLATFORMS=cpu \
+            LD_PRELOAD="$asan $ubsan" \
+            ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+            UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1 \
+            python scripts/emit_smoke.py || fail=1
+    else
+        echo "ci.sh: sanitize build failed" >&2
+        fail=1
+    fi
+else
+    echo "== emit smoke (ASan/UBSan) == (opt-in; GW_SANITIZE=1 to run)"
+fi
+
+# 16. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -16,9 +16,10 @@ from __future__ import annotations
 import textwrap
 from pathlib import Path
 
-from goworld_tpu.analysis import coverage, determinism, dtypes, \
+from goworld_tpu.analysis import RULES, coverage, determinism, dtypes, \
     fault_seams, flush_phase, fused_dispatch, h2d_staging, host_sync, \
-    oracle_parity, telemetry_rule, wire_protocol
+    msg_flow, oracle_parity, recompile_churn, telemetry_rule, \
+    thread_discipline, wire_protocol
 from goworld_tpu.analysis.__main__ import main as gwlint_main
 from goworld_tpu.analysis.core import run
 
@@ -1022,3 +1023,411 @@ def test_cli_exit_codes(tmp_path, capsys):
     bad.write_text("ops/hot.py::host-sync\n")
     assert gwlint_main([str(dirty), "--root", str(dirty),
                         "--suppressions", str(bad)]) == 2
+
+
+# -- recompile-churn ---------------------------------------------------------
+
+RECHURN = """\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    _warm = jax.jit(jnp.cumsum)  # module level: the sanctioned home
+
+    def tick(xs, scale):
+        def step(x):
+            return x * scale
+        fn = jax.jit(step)
+        return fn(xs)
+
+    def fanout(batches):
+        out = []
+        for b in batches:
+            out.append(jax.jit(lambda x: x + 1)(b))
+        return out
+
+    def make_step(cfg):
+        def step(x):
+            return x + cfg.bias
+        return jax.jit(step)
+
+    _CACHE = {}
+
+    def cached(key, xs):
+        def step(x):
+            return x
+        if key not in _CACHE:
+            _CACHE[key] = jax.jit(step)
+        return _CACHE[key](xs)
+
+    def warmup(xs):  # gwlint: allow[recompile-churn] -- fixture: one-shot boot probe
+        return jax.jit(lambda x: x)(xs)
+
+    @functools.partial(jax.jit, static_argnames=("tick",))
+    def stepped(tick, xs):
+        return xs + tick
+
+    @jax.jit
+    def clamp(x, lo):
+        if lo > 0:
+            return x + lo
+        return x
+
+    @jax.jit
+    def shaped(x, y):
+        if x is None:
+            return y
+        if x.ndim > 1:
+            return x
+        return x + y
+"""
+
+
+def test_recompile_churn_unmemoized_and_loop(tmp_path):
+    _mk(tmp_path, {"ops/jit.py": RECHURN})
+    findings, _ = _run(tmp_path, [recompile_churn.check])
+    by_line = {f.line: f for f in findings}
+    # fresh wrapper per call, with the captured scalar named
+    ln = _ln(RECHURN, "fn = jax.jit(step)")
+    assert ln in by_line
+    assert "no memoization" in by_line[ln].message
+    assert "closure-captures scale" in by_line[ln].message
+    # construction inside a loop
+    ln = _ln(RECHURN, "jax.jit(lambda x: x + 1)(b)")
+    assert ln in by_line and "a loop in fanout()" in by_line[ln].message
+    # high-cardinality static arg
+    ln = _ln(RECHURN, "static_argnames=")
+    assert ln in by_line and "static arg 'tick'" in by_line[ln].message
+    # python branch on a traced parameter
+    ln = _ln(RECHURN, "if lo > 0:")
+    assert ln in by_line and "traced parameter 'lo'" in by_line[ln].message
+    # nothing else: the factory return, the keyed cache, the module-level
+    # jit, the allow'd warmup, and the is-None/.ndim guards are all clean
+    assert len(findings) == 4, "\n".join(f.render() for f in findings)
+    assert all(f.rule == "recompile-churn" for f in findings)
+
+
+def test_recompile_churn_suppression_file(tmp_path):
+    _mk(tmp_path, {"ops/jit.py": RECHURN})
+    sup = tmp_path / "gwlint.suppressions"
+    sup.write_text("ops/jit.py::recompile-churn -- fixture: measured elsewhere\n")
+    findings, errors = _run(tmp_path, [recompile_churn.check],
+                            suppressions=str(sup))
+    assert findings == [] and errors == []
+
+
+# -- thread-discipline -------------------------------------------------------
+
+TD_WRITER = """\
+    import threading
+
+    class Writer:
+        def __init__(self):
+            self.stats = {}
+            self.thread = threading.Thread(target=self._writer_loop)
+            self.thread.start()
+
+        def _writer_loop(self):
+            while True:
+                self.stats = {"flushed": 1}
+
+        def step(self):
+            return self.stats
+
+    class GoodWriter:
+        def __init__(self):
+            self.stats = {}
+            self._wake = threading.Event()
+            threading.Thread(target=self._loop).start()
+
+        def _loop(self):
+            while True:
+                self._wake.wait()
+                self.stats = {"flushed": 1}
+
+        def step(self):
+            return self.stats
+"""
+
+TD_CLUSTER = """\
+    import threading
+
+    class Cluster:
+        def __init__(self, n):
+            self.conns = [None] * n
+            for i in range(n):
+                threading.Thread(target=self._maintain, args=(i,)).start()
+
+        def _maintain(self, i):
+            self.conns[i] = object()
+
+        def send(self, i):
+            return self.conns[i]
+
+    class GoodCluster:
+        def __init__(self, n):
+            self._mu = threading.Lock()
+            self.conns = [None] * n
+            for i in range(n):
+                threading.Thread(target=self._maintain, args=(i,)).start()
+
+        def _maintain(self, i):
+            with self._mu:
+                self.conns[i] = object()
+
+        def send(self, i):
+            with self._mu:
+                return self.conns[i]
+
+    class Allowed:
+        def __init__(self):
+            self.last = 0.0
+            threading.Thread(target=self._loop).start()
+
+        def _loop(self):  # gwlint: allow[thread-discipline] -- fixture: monotonic float, torn reads acceptable
+            self.last = 1.0
+
+        def step(self):
+            return self.last
+"""
+
+
+def test_thread_discipline_checkpoint_writer_shape(tmp_path):
+    _mk(tmp_path, {"engine/ckpt.py": TD_WRITER})
+    findings, _ = _run(tmp_path, [thread_discipline.check])
+    assert len(findings) == 1, "\n".join(f.render() for f in findings)
+    f = findings[0]
+    assert f.rule == "thread-discipline"
+    assert f.path == "engine/ckpt.py"
+    assert f.line == _ln(TD_WRITER, 'self.stats = {"flushed": 1}')
+    assert "self.stats" in f.message and "step()" in f.message
+    assert "self._writer_loop" in f.message
+    # GoodWriter's loop references self._wake (an Event): guarded
+
+
+def test_thread_discipline_dispatcher_reconnect_shape(tmp_path):
+    _mk(tmp_path, {"engine/cluster.py": TD_CLUSTER})
+    findings, _ = _run(tmp_path, [thread_discipline.check])
+    assert len(findings) == 1, "\n".join(f.render() for f in findings)
+    f = findings[0]
+    assert f.line == _ln(TD_CLUSTER, "self.conns[i] = object()")
+    assert "self.conns" in f.message and "send()" in f.message
+    # GoodCluster holds self._mu on both sides; Allowed carries the
+    # def-line allow -- neither is a finding
+
+
+def test_thread_discipline_suppression_file(tmp_path):
+    _mk(tmp_path, {"engine/ckpt.py": TD_WRITER})
+    sup = tmp_path / "gwlint.suppressions"
+    sup.write_text(
+        "engine/ckpt.py::thread-discipline::Writer._writer_loop "
+        "-- fixture: single-reader stats\n")
+    findings, errors = _run(tmp_path, [thread_discipline.check],
+                            suppressions=str(sup))
+    assert findings == [] and errors == []
+
+
+# -- msg-flow ----------------------------------------------------------------
+
+MF_MSGTYPES = """\
+    MT_GOOD = 1
+    MT_DEAD = 2
+    MT_NO_SENDER = 3
+    MT_NO_HANDLER = 4
+    MT_UNROUTED = 5
+    MT_ALLOWED = 6  # gwlint: allow[msg-flow] -- fixture: staged rollout
+    MT_GATE_SERVICE_BEGIN = 1000
+    MT_REDIRECT_TO_CLIENT_BEGIN = 1001
+    MT_REDIR = 1002
+    MT_REDIRECT_TO_CLIENT_END = 1499
+    MT_GATE_SERVICE_END = 1999
+    MT_DIRECT = 2001
+"""
+
+MF_GAME = """\
+    from ..proto import msgtypes as MT
+
+    class Packet:
+        @classmethod
+        def for_msgtype(cls, mt):
+            return cls()
+
+    _GAME_HANDLERS = {}
+
+    def _h_unrouted(pkt):
+        return pkt
+
+    _GAME_HANDLERS[1] = None
+
+    _TABLE = {MT.MT_UNROUTED: _h_unrouted}
+
+    def send_all():
+        Packet.for_msgtype(MT.MT_GOOD)
+        Packet.for_msgtype(MT.MT_NO_HANDLER)
+        Packet.for_msgtype(MT.MT_UNROUTED)
+        Packet.for_msgtype(MT.MT_REDIR)
+        Packet.for_msgtype(MT.MT_DIRECT)
+"""
+
+MF_DISP = """\
+    from ...proto import msgtypes as MT
+
+    def _h_good(pkt):
+        return pkt
+
+    _HANDLERS = {MT.MT_GOOD: _h_good, MT.MT_NO_SENDER: _h_good}
+
+    def route(mt):
+        return mt == MT.MT_DIRECT
+"""
+
+MF_GATE = """\
+    from ..proto import msgtypes as MT
+
+    def on_packet(mt, pkt):
+        if mt == MT.MT_REDIR:
+            return pkt
+        return None
+"""
+
+MF_TREE = {
+    "goworld_tpu/proto/msgtypes.py": MF_MSGTYPES,
+    "goworld_tpu/components/game/service.py": MF_GAME,
+    "goworld_tpu/components/dispatcher/service.py": MF_DISP,
+    "goworld_tpu/gate/service.py": MF_GATE,
+}
+
+
+def test_msg_flow_findings_anchor_at_constants(tmp_path):
+    _mk(tmp_path, MF_TREE)
+    findings, _ = _run(tmp_path, [msg_flow.check])
+    rel = "goworld_tpu/proto/msgtypes.py"
+    assert all(f.path == rel and f.rule == "msg-flow" for f in findings)
+    msgs = {(f.line, frag) for f in findings
+            for frag in ("is dead", "handled but never sent",
+                         "sent but never handled",
+                         "the dispatcher never references it")
+            if frag in f.message}
+    assert msgs == {
+        (_ln(MF_MSGTYPES, "MT_DEAD"), "is dead"),
+        (_ln(MF_MSGTYPES, "MT_NO_SENDER"), "handled but never sent"),
+        (_ln(MF_MSGTYPES, "MT_NO_HANDLER"), "sent but never handled"),
+        (_ln(MF_MSGTYPES, "MT_NO_HANDLER"),
+         "the dispatcher never references it"),
+        (_ln(MF_MSGTYPES, "MT_UNROUTED"),
+         "the dispatcher never references it"),
+    }, "\n".join(f.render() for f in findings)
+    # MT_ALLOWED is dead too but carries the inline allow; MT_REDIR rides
+    # the REDIRECT band (pass-through exempt); MT_DIRECT is direct-band
+    # and dispatcher-compared; band markers are never findings
+
+
+def test_msg_flow_cli_exit_codes_and_suppression(tmp_path, capsys):
+    root = _mk(tmp_path, MF_TREE)
+    assert gwlint_main([str(root), "--root", str(root)]) == 1
+    out = capsys.readouterr().out
+    assert "[msg-flow]" in out and "MT_DEAD" in out
+
+    sup = tmp_path / "gwlint.suppressions"
+    sup.write_text("goworld_tpu/proto/msgtypes.py::msg-flow "
+                   "-- fixture: protocol under construction\n")
+    assert gwlint_main([str(root), "--root", str(root),
+                        "--suppressions", str(sup)]) == 0
+
+
+# -- CLI formats, --profile, --changed-only ----------------------------------
+
+def test_cli_json_and_sarif_and_github_formats(tmp_path, capsys):
+    import json
+
+    root = _mk(tmp_path, {"ops/hot.py": HOT})
+    line = _ln(HOT, "np.asarray(x)")
+
+    assert gwlint_main([str(root), "--root", str(root),
+                        "--format", "json"]) == 1
+    recs = json.loads(capsys.readouterr().out)
+    assert {(r["rule"], r["path"], r["line"]) for r in recs} >= \
+        {("host-sync", "ops/hot.py", line)}
+
+    assert gwlint_main([str(root), "--root", str(root),
+                        "--format", "sarif"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    drv = doc["runs"][0]["tool"]["driver"]
+    assert drv["name"] == "gwlint"
+    assert {r["id"] for r in drv["rules"]} == set(RULES)
+    locs = {(r["ruleId"],
+             r["locations"][0]["physicalLocation"]["artifactLocation"]["uri"],
+             r["locations"][0]["physicalLocation"]["region"]["startLine"])
+            for r in doc["runs"][0]["results"]}
+    assert ("host-sync", "ops/hot.py", line) in locs
+
+    assert gwlint_main([str(root), "--root", str(root),
+                        "--format", "github"]) == 1
+    out = capsys.readouterr().out
+    assert f"::error file=ops/hot.py,line={line}," in out
+    assert "[host-sync]" in out
+
+
+def test_profile_proves_parse_once_across_all_rules(tmp_path, capsys):
+    root = _mk(tmp_path, {"ops/hot.py": HOT, "pkg/ok.py": "X = 1\n"})
+    assert gwlint_main([str(root), "--root", str(root), "--profile"]) == 1
+    err = capsys.readouterr().err
+    assert "2 files, 2 parses (parse-once: yes)" in err
+    for rule in RULES:
+        assert f"gwlint: profile: {rule}" in err
+
+    profile: dict = {}
+    findings, _ = run([str(root)], root=str(root), profile=profile)
+    assert profile["files"] == profile["parses"] == 2
+    assert [r for r, _t in profile["rules"]] == list(RULES)
+
+
+def test_changed_only_filters_findings_not_the_scan(tmp_path):
+    import shutil
+    import subprocess
+
+    if shutil.which("git") is None:
+        import pytest
+        pytest.skip("git unavailable")
+    root = _mk(tmp_path, {"ops/old.py": HOT})
+
+    def _git(*args):
+        r = subprocess.run(["git", *args], cwd=root, capture_output=True,
+                           text=True)
+        assert r.returncode == 0, r.stderr
+
+    _git("init", "-q")
+    _git("-c", "user.email=t@t", "-c", "user.name=t", "add", ".")
+    _git("-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    _mk(root, {"ops/new.py": HOT})  # untracked counts as changed
+
+    assert gwlint_main([str(root), "--root", str(root),
+                        "--changed-only", "HEAD"]) == 1
+    findings, _ = run([str(root)], root=str(root),
+                      only_files={"ops/new.py"})
+    assert findings and {f.path for f in findings} == {"ops/new.py"}
+
+    assert gwlint_main([str(root), "--root", str(root),
+                        "--changed-only", "no-such-ref"]) == 2
+
+
+# -- docs <-> registry sync --------------------------------------------------
+
+def test_docs_rule_headers_match_registry():
+    """The doc-count drift that motivated gwlint v2 cannot recur: the
+    checker sections in docs/static-analysis.md and the written-out
+    count must both track the RULES registry exactly."""
+    import re
+
+    doc = (REPO / "docs" / "static-analysis.md").read_text()
+    doc_rules = re.findall(r"^### `([a-z0-9\-]+)`", doc, flags=re.M)
+    assert sorted(doc_rules) == sorted(RULES), \
+        (set(doc_rules) ^ set(RULES))
+    words = {12: "twelve", 13: "thirteen", 14: "fourteen", 15: "fifteen",
+             16: "sixteen", 17: "seventeen", 18: "eighteen"}
+    assert f"{words[len(RULES)]} AST checkers" in doc
+    init_doc = (REPO / "goworld_tpu" / "analysis" / "__init__.py").read_text()
+    assert f"{words[len(RULES)].capitalize()} checkers" in init_doc
