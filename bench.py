@@ -1754,6 +1754,147 @@ def bench_engine_clustered(cfg, cap=2048, n=1800, ticks=8):
     }
 
 
+def _multispace_frames(n_spaces, cap, n, ticks, world, seed=31):
+    """Per-tick, per-space (x, z) frames for the many-small-spaces walk:
+    sparse movement (~10% movers/tick) so the steady tick stays on the
+    fused path.  One rng drives every space so both A/B sides (and the
+    CPU oracle) see byte-identical positions."""
+    rng = np.random.default_rng(seed)
+    xs = [rng.uniform(0, world, n).astype(np.float32)
+          for _ in range(n_spaces)]
+    zs = [rng.uniform(0, world, n).astype(np.float32)
+          for _ in range(n_spaces)]
+    frames = []
+    for _t in range(ticks):
+        frame = []
+        for s in range(n_spaces):
+            move = rng.random(n) < 0.1
+            k = int(move.sum())
+            xs[s][move] = np.clip(
+                xs[s][move] + rng.uniform(-15, 15, k), 0,
+                world).astype(np.float32)
+            zs[s][move] = np.clip(
+                zs[s][move] + rng.uniform(-15, 15, k), 0,
+                world).astype(np.float32)
+            frame.append((xs[s].copy(), zs[s].copy()))
+        frames.append(frame)
+    return frames
+
+
+def _multispace_run(frames, caps, n, radius, warmup, **eng_kwargs):
+    """Drive the many-spaces walk through one AOIEngine; crc32-fold every
+    space's enter/leave stream in fixed space order (the parity oracle)
+    and bracket the measured window with the dispatch/recompile meters
+    (ops/dispatch_count)."""
+    from goworld_tpu import faults
+    from goworld_tpu.engine.aoi import AOIEngine
+    from goworld_tpu.ops import dispatch_count as _DC
+
+    faults.clear()
+    eng = AOIEngine(**eng_kwargs)
+    hs = [eng.create_space(c) for c in caps]
+    r = np.full(n, radius, np.float32)
+    act = np.ones(n, bool)
+    crc, walls = 0, []
+    for t, frame in enumerate(frames):
+        if t == warmup:
+            _DC.reset()
+            _DC.reset_keys()  # keep the seen set: new keys = recompiles
+        t0 = time.perf_counter()
+        for h, (x, z) in zip(hs, frame):
+            eng.submit(h, x, z, r, act)
+        eng.flush()
+        evs = [eng.take_events(h) for h in hs]
+        walls.append(time.perf_counter() - t0)
+        for e, lv in evs:
+            crc = zlib.crc32(np.ascontiguousarray(lv, np.int32).tobytes(),
+                             zlib.crc32(np.ascontiguousarray(
+                                 e, np.int32).tobytes(), crc))
+    n_buckets = len({id(h.bucket) for h in hs})
+    return {"crc": crc, "walls": walls[warmup:],
+            "dispatches": _DC.read(), "recompiles": _DC.new_keys(),
+            "buckets": n_buckets}
+
+
+def bench_engine_multispace(cfg, n_spaces=256, cap=128, n=96, ticks=8,
+                            warmup=3):
+    """Space-stacked megabatch A/B (ROADMAP #2, docs/perf.md
+    "Space-stacked cohorts"): the SAME many-small-spaces walk (256
+    spaces by default -- the goworld shard shape: hundreds of scenes,
+    ~100 entities each) through
+
+      * ``cohort="auto"``: every space stacks into ONE ladder-shaped
+        cohort bucket -> one fused device program per tick for the
+        whole shard;
+      * ``cohort="solo"``: the per-space baseline -- one exclusive
+        bucket, one dispatch per space per tick.
+
+    The acceptance meters: ``device_dispatches_per_tick`` at <= 0.05x
+    the solo baseline (1 cohort launch vs n_spaces launches),
+    ``recompiles_after_warmup`` = 0 on both sides (the pow2 ladder keeps
+    the jit key set O(ladder)), and a bit-identical ``parity_checksum``
+    between cohort, solo and the CPU oracle.  Returns the cohort record
+    plus a slim solo-baseline record so the pair rides the recap
+    together."""
+    caps = [cap] * n_spaces
+    frames = _multispace_frames(n_spaces, cap, n, ticks, cfg.world / 4)
+    ladder = (max(256, cap),)
+    res = {
+        "cpu": _multispace_run(frames, caps, n, cfg.radius, warmup,
+                               default_backend="cpu"),
+        "cohort": _multispace_run(frames, caps, n, cfg.radius, warmup,
+                                  default_backend="tpu", fused=True,
+                                  cohort="auto", cohort_ladder=ladder),
+        "solo": _multispace_run(frames, caps, n, cfg.radius, warmup,
+                                default_backend="tpu", fused=True,
+                                cohort="solo"),
+    }
+    meas = ticks - warmup
+    co, so = res["cohort"], res["solo"]
+    disp_pt = co["dispatches"] / meas
+    solo_pt = so["dispatches"] / meas
+    moves = n_spaces * n * meas
+    rec = {
+        "metric": "engine_multispace",
+        "config": "engine_multispace",
+        "kind": "space-stacked cohort vs per-space dispatch A/B",
+        "value": round(moves / sum(co["walls"])),
+        "unit": "moves/s",
+        "rate_kind": "e2e",
+        "detail": f"{n_spaces} spaces x {n} entities (cap {cap}) stacked "
+                  f"into {co['buckets']} cohort bucket(s) vs "
+                  f"{so['buckets']} solo buckets; {meas} measured ticks "
+                  f"after {warmup} warmup",
+        "n_spaces": n_spaces,
+        "cohort_buckets": co["buckets"],
+        "ticks": meas,
+        "device_dispatches_per_tick": round(disp_pt, 2),
+        "solo_dispatches_per_tick": round(solo_pt, 2),
+        "dispatch_ratio": round(disp_pt / solo_pt, 4),
+        "recompiles_after_warmup": co["recompiles"],
+        "solo_recompiles_after_warmup": so["recompiles"],
+        "parity_ok": co["crc"] == so["crc"] == res["cpu"]["crc"],
+        "parity_checksum": f"{co['crc']:08x}",
+        "ms_per_tick": round(sum(co["walls"]) / meas * 1e3, 2),
+        "solo_ms_per_tick": round(sum(so["walls"]) / meas * 1e3, 2),
+    }
+    solo_rec = {
+        "metric": "engine_multispace",
+        "config": "engine_multispace_solo",
+        "kind": "per-space dispatch baseline",
+        "value": round(moves / sum(so["walls"])),
+        "unit": "moves/s",
+        "rate_kind": "e2e",
+        "n_spaces": n_spaces,
+        "device_dispatches_per_tick": round(solo_pt, 2),
+        "recompiles_after_warmup": so["recompiles"],
+        "parity_ok": so["crc"] == res["cpu"]["crc"],
+        "parity_checksum": f"{so['crc']:08x}",
+        "ms_per_tick": round(sum(so["walls"]) / meas * 1e3, 2),
+    }
+    return [rec, solo_rec]
+
+
 def _ingest_walk(cfg, batched, n, ticks, cross_tick=False, backend="tpu"):
     """Drive one client-sync movement wave through a Runtime, arriving as
     gate-flush-shaped wire packets; decode per-entity or batched.  The
@@ -2435,14 +2576,18 @@ def main():
         print("# sentinel skipped: no accelerator (it measures chip/tunnel "
               "environment drift)", file=sys.stderr, flush=True)
     headline = None
+    # skipped configs collect into ONE summary line + meta record at the
+    # end instead of a per-config stderr spray (a 20-config chip-less run
+    # used to print 15 near-identical "# skipping ..." lines, burying the
+    # real diagnostics; the driver's log tail only keeps the stream end)
+    skipped = []
     for cfg in matrix:
         if not on_tpu and getattr(cfg, "kernel_level", False):
-            print(f"# skipping {cfg.name}: kernel-level config needs an "
-                  "accelerator", file=sys.stderr, flush=True)
+            skipped.append((cfg.name, "kernel-level config needs an "
+                                      "accelerator"))
             continue
         if not cfg.headline and time.perf_counter() - t0 > TIME_BUDGET_S:
-            print(f"# skipping {cfg.name}: time budget exceeded",
-                  file=sys.stderr, flush=True)
+            skipped.append((cfg.name, "time budget exceeded"))
             continue
         # One config blowing up (a real device OOM, or an injected
         # bench.config fault) must not void the rest of the matrix: it gets
@@ -2465,6 +2610,15 @@ def main():
                 # platform-agnostic like the two above -- the paged layout
                 # must retire the overflow class the capped one flags
                 emit(bench_engine_clustered(cfg))
+                # space-stacked cohort A/B (docs/perf.md "Space-stacked
+                # cohorts"), platform-agnostic like the rows above: the
+                # same 256-small-spaces walk stacked into one shared
+                # ladder bucket vs per-space solo buckets.  The meters:
+                # device_dispatches_per_tick <= 0.05x the solo baseline,
+                # recompiles_after_warmup = 0 both sides, bit-identical
+                # parity_checksum vs solo AND the CPU oracle
+                for rec in bench_engine_multispace(cfg):
+                    emit(rec)
                 # batched wire->column ingest A/B (docs/perf.md "Batched
                 # movement ingest"), platform-agnostic like the three
                 # above: the same client-sync wire wave decoded
@@ -2569,6 +2723,18 @@ def main():
             except Exception:
                 pass
             gc.collect()
+    if skipped:
+        by_reason: dict = {}
+        for name, reason in skipped:
+            by_reason.setdefault(reason, []).append(name)
+        parts = "; ".join(f"{reason}: {', '.join(names)}"
+                          for reason, names in sorted(by_reason.items()))
+        print(f"# skipped {len(skipped)} config(s) -- {parts}",
+              file=sys.stderr, flush=True)
+        emit({"metric": "meta", "config": "skipped",
+              "skipped_configs": [name for name, _r in skipped],
+              "reasons": {reason: names
+                          for reason, names in sorted(by_reason.items())}})
     # headline e2e rides the tunnel's weather: re-measure it at the END of
     # the run too and record the better of the two windows (round-4 verdict
     # item 4 -- one bad window must not be the round's official number)
@@ -2629,6 +2795,11 @@ def main():
                          ("auto_backend", "auto"),
                          ("wall_vs_device_ratio", "wall_dev"),
                          ("device_dispatches_per_tick", "disp_pt"),
+                         ("solo_dispatches_per_tick", "solo_disp"),
+                         ("dispatch_ratio", "disp_ratio"),
+                         ("recompiles_after_warmup", "recomp"),
+                         ("n_spaces", "spaces"),
+                         ("solo_ms_per_tick", "solo_ms"),
                          ("aoi_fused_dispatches", "fused_n"),
                          ("aoi_fused_demotions", "fused_demo"),
                          ("aoi_emit", "emit"),

@@ -12,9 +12,14 @@ site table):
   cache, so every call retraces.  The tree's sanctioned idioms are
   recognized as memo evidence -- the compiled fn (or a decorated inner
   def) escaping into a ``global``-declared name, a ``self.X``
-  attribute, or a keyed cache subscript (``self._step_cache[key] =
-  fn``); construction inside an already-jitted function is traced
-  once with its parent and also fine.
+  attribute, a keyed cache subscript (``self._step_cache[key] = fn``),
+  or the argument of a helper/registrar call
+  (``cache.setdefault(key, fn)``, ``_memo_step(key, jax.jit(step))``
+  -- the ops/aoi_cohort cohort-cache idiom); construction inside an
+  already-jitted function is traced once with its parent and also
+  fine.  Invoking the fresh wrapper (``jax.jit(f)(x)``) is NOT memo
+  evidence: the wrapper sits in func position, not an argument, and
+  still flags.
 * closure-captured Python scalars where an argument belongs: a
   non-memoized inner def that bakes enclosing locals into the trace
   recompiles whenever they change (reported with the captured names).
@@ -144,9 +149,13 @@ def _jit_aliases_and_escape(outer, sites: list[ast.AST]) -> tuple[set, bool]:
                         elif t.id not in aliases:
                             aliases.add(t.id)
                             changed = True
-            elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
-                # cache.setdefault(key, fn) / self._warm(fn): handing the
-                # compiled fn to a container or helper counts as memoized
+            elif isinstance(n, ast.Call) \
+                    and isinstance(n.func, (ast.Attribute, ast.Name)):
+                # cache.setdefault(key, fn) / self._warm(fn) / the plain
+                # registrar form _memo_step(key, fn): handing the compiled
+                # fn to a container or helper counts as memoized.  Only
+                # ARGUMENT position counts -- jax.jit(f)(x) puts the fresh
+                # wrapper in func position (an invocation) and still flags
                 if any(_is_jit_value(a) for a in n.args) \
                         or any(_is_jit_value(kw.value) for kw in n.keywords):
                     escaped = True

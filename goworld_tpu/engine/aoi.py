@@ -37,6 +37,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .. import faults, telemetry
+from ..ops import aoi_cohort as AC
 from ..ops import aoi_emit as AE
 from ..ops import aoi_fused as AF
 from ..ops import aoi_pages as PG
@@ -702,8 +703,29 @@ class AOIEngine:
                  rowshard_min_capacity: int = 65536,
                  flush_sched: bool = True, emit: str = "auto",
                  paged: bool = False, cross_tick: bool = False,
-                 interest_mode: str = "device", fused: bool = False):
+                 interest_mode: str = "device", fused: bool = False,
+                 cohort=False, cohort_ladder=None):
         self.default_backend = default_backend
+        # space-stacked cohorts (ROADMAP #2, ops/aoi_cohort, docs/perf.md
+        # "Space-stacked cohorts"): "auto"/True stacks small device-eligible
+        # spaces into shared ladder-shaped _CohortTPUBucket planes so ONE
+        # launch ticks the whole cohort; "solo" forces one exclusive bucket
+        # per space -- the O(spaces)-dispatches baseline the engine_multispace
+        # bench A/Bs against (and the demotion target of the aoi.cohort
+        # seam); False keeps classic (backend, capacity) pooling.  Cohorts
+        # are a single-chip tier: a mesh engine keeps its mesh routing.
+        if cohort is True:
+            cohort = "auto"
+        if cohort not in (False, "auto", "solo"):
+            raise ValueError(
+                f"aoi_cohort must be False|True|'auto'|'solo', got "
+                f"{cohort!r}")
+        self.cohort = cohort
+        self.cohort_ladder = AC.validate_ladder(
+            cohort_ladder if cohort_ladder is not None else AC.DEFAULT_LADDER)
+        self._cohort_serial = 0
+        self.cohort_stats = {"cohort_joins": 0, "cohort_leaves": 0,
+                             "cohort_demoted_spaces": 0}
         # fused steady tick (ops/aoi_fused, ROADMAP #3): each device
         # bucket compiles its steady-state tick into ONE jitted program
         # (one enqueue + one D2H fetch); unfused stays the A/B baseline
@@ -846,6 +868,26 @@ class AOIEngine:
     def create_space(self, capacity: int, backend: str | None = None) -> SpaceAOIHandle:
         requested = backend or self.default_backend
         capacity = P.round_capacity(capacity)
+        if self.cohort and self.mesh is None \
+                and requested in ("tpu", "auto"):
+            # cohort routing (docs/perf.md "Space-stacked cohorts"): a
+            # device-eligible space inside the ladder range rounds UP to
+            # its pow2 ladder shape -- "auto" stacks it into the shared
+            # cohort bucket at that shape (one launch per cohort, not per
+            # space), "solo" pins it to an exclusive per-space bucket
+            # (the O(spaces) baseline / demotion target).  Spaces past
+            # the ladder ceiling keep the classic routing below.
+            shape = AC.cohort_shape(capacity, self.cohort_ladder)
+            if shape is not None:
+                if self.cohort == "solo":
+                    h = self._solo_handle(shape)
+                else:
+                    bucket = self._cohort_bucket(shape)
+                    slot = bucket.acquire_slot()
+                    h = SpaceAOIHandle("tpu", shape, bucket, slot)
+                    self._handles.add(h)
+                h.requested = requested
+                return h
         backend = requested
         if backend == "auto":
             # capacity routing: tiny spaces are dispatch-bound on an
@@ -988,6 +1030,171 @@ class AOIEngine:
             self._emit_resolved = AE.resolve_mode(self.emit)
         return self._emit_resolved
 
+    # -- space-stacked cohorts (docs/perf.md "Space-stacked cohorts") -----
+
+    def _cohort_bucket(self, shape: int):
+        """Get-or-create the shared cohort bucket at a ladder shape.  One
+        bucket per shape: membership churn re-buckets spaces between
+        ladder rungs, never mints new shapes, so the jit key set -- and
+        therefore recompiles -- stays pinned after warmup."""
+        key = ("tpu-cohort", shape)
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            from .aoi_cohort import _CohortTPUBucket
+
+            bucket = _CohortTPUBucket(
+                shape, pipeline=self.pipeline, cross_tick=self.cross_tick,
+                delta_staging=self.delta_staging, emit=self._resolve_emit(),
+                paged=self.paged, fused=self.fused)
+            self._buckets[key] = bucket
+        return bucket
+
+    def _solo_bucket(self, capacity: int):
+        """One EXCLUSIVE single-space device bucket: the per-space
+        baseline (``cohort="solo"``) and the ``aoi.cohort`` demotion
+        target.  ``exclusive`` frees it with its space (release_space);
+        ``cohort_solo`` marks it for :meth:`recohort` and maps its tier
+        back to ``tpu`` under chip-loss evacuation."""
+        self._cohort_serial += 1
+        bucket = _TPUBucket(capacity, pipeline=self.pipeline,
+                            cross_tick=self.cross_tick,
+                            delta_staging=self.delta_staging,
+                            emit=self._resolve_emit(), paged=self.paged,
+                            fused=self.fused)
+        bucket.exclusive = True
+        bucket.cohort_solo = True
+        self._buckets[(f"tpu-solo-{self._cohort_serial}", capacity)] = bucket
+        return bucket
+
+    def _solo_handle(self, capacity: int) -> SpaceAOIHandle:
+        bucket = self._solo_bucket(capacity)
+        slot = bucket.acquire_slot()
+        h = SpaceAOIHandle("tpu", capacity, bucket, slot, requested="tpu")
+        self._handles.add(h)
+        return h
+
+    def _restack_handle(self, h: SpaceAOIHandle, bucket, shape: int) -> None:
+        """Move one live space onto ``bucket`` (capacity ``shape`` >= the
+        space's) through the snapshot seam -- the join/leave primitive.
+        Runs between flushes; undelivered events and a staged-but-
+        undispatched tick are carried, so nothing drops or doubles.
+        Snapshot padding is bit-exact: the grown tail is inactive and the
+        predicate never reports inactive slots."""
+        mig = getattr(h, "_migration", None)
+        if mig is not None:
+            mig.abort("space re-stacked mid-cover")
+        old_bucket, old_slot = h.bucket, h.slot
+        snap = AC.pad_snapshot(old_bucket.export_snapshot(old_slot), shape)
+        staged = old_bucket._staged.pop(old_slot, None)
+        slot = bucket.acquire_slot()
+        bucket.import_snapshot(slot, snap)
+        pending = old_bucket._events.pop(old_slot, None)
+        if pending is not None:
+            bucket._events[slot] = pending
+        if staged is not None:
+            bucket.stage(slot, staged)
+        old_bucket.release_slot(old_slot)
+        if getattr(old_bucket, "exclusive", False):
+            for k, b in list(self._buckets.items()):
+                if b is old_bucket:
+                    del self._buckets[k]
+        stack = getattr(h, "_policy_stack", None)
+        if stack is not None and shape != h.capacity:
+            stack.grow(shape)
+        h.bucket, h.slot = bucket, slot
+        h.capacity, h.backend = shape, "tpu"
+
+    def cohort_join(self, h: SpaceAOIHandle) -> SpaceAOIHandle:
+        """Stack a live space into the shared cohort bucket at its ladder
+        shape (planner stack decision, or re-arming after a demotion).
+        In place: the handle object survives, re-pointed."""
+        if h.released:
+            raise ValueError("space AOI handle already released")
+        if self.mesh is not None:
+            raise ValueError("cohorts are a single-chip tier")
+        shape = AC.cohort_shape(h.capacity, self.cohort_ladder)
+        if shape is None:
+            raise ValueError(
+                f"capacity {h.capacity} is past the cohort ladder "
+                f"{self.cohort_ladder}")
+        bucket = self._cohort_bucket(shape)
+        if h.bucket is bucket:
+            return h
+        with _T.span("aoi.cohort.join"):
+            self._restack_handle(h, bucket, shape)
+        self.cohort_stats["cohort_joins"] += 1
+        return h
+
+    def cohort_leave(self, h: SpaceAOIHandle) -> SpaceAOIHandle:
+        """Un-stack a live space onto its own solo bucket (planner
+        keep-solo decision: e.g. one hot space must not gate its cohort's
+        shared launch).  In place, like :meth:`cohort_join`."""
+        if h.released:
+            raise ValueError("space AOI handle already released")
+        if not getattr(h.bucket, "cohort", False):
+            return h
+        with _T.span("aoi.cohort.leave"):
+            self._restack_handle(h, self._solo_bucket(h.capacity),
+                                 h.capacity)
+        self.cohort_stats["cohort_leaves"] += 1
+        return h
+
+    def recohort(self) -> int:
+        """Re-arm after ``aoi.cohort`` demotions: stack every space now
+        sitting on a demoted/planner solo bucket back into its cohort.
+        Returns the number of spaces moved.  (The fault seam stays
+        one-shot per cohort bucket instance -- a fresh bucket probes the
+        seam fresh, so a re-armed plan can fire again.)"""
+        moved = 0
+        for h in list(self._handles):
+            if h.released or not getattr(h.bucket, "cohort_solo", False):
+                continue
+            self.cohort_join(h)
+            moved += 1
+        return moved
+
+    def _demote_cohort(self, bucket) -> list:
+        """The ``aoi.cohort`` seam fired at this bucket's dispatch (its
+        shared program is suspect; nothing was staged to the device this
+        tick): rebuild every member space onto its own solo bucket NOW,
+        re-staging this tick's inputs, and return the fresh buckets still
+        undispatched so flush() runs them under whichever phase
+        discipline is active -- the republish is same-tick and bit-exact.
+        """
+        t0 = time.perf_counter()
+        new_buckets: list = []
+        with _T.span("aoi.cohort.demote"):
+            for m in [m for m in self._migrations
+                      if m.h.bucket is bucket or m.t.bucket is bucket]:
+                m.abort("cohort demoting to per-space dispatch")
+            staged = dict(bucket._staged)
+            bucket._staged.clear()
+            snaps = bucket.evacuate()
+            for k, b in list(self._buckets.items()):
+                if b is bucket:
+                    del self._buckets[k]
+            owners = {h.slot: h for h in self._handles
+                      if h.bucket is bucket and not h.released}
+            for slot in sorted(snaps):
+                h = owners.get(slot)
+                if h is None:
+                    continue  # no live Space behind the slot
+                nb = self._solo_bucket(h.capacity)
+                ns = nb.acquire_slot()
+                nb.import_snapshot(ns, snaps[slot])
+                pending = bucket._events.pop(slot, None)
+                if pending is not None:
+                    nb._events[ns] = pending
+                tick = staged.get(slot)
+                if tick is not None:
+                    nb.stage(ns, tick)
+                h.bucket, h.slot = nb, ns
+                self.cohort_stats["cohort_demoted_spaces"] += 1
+                new_buckets.append(nb)
+        self.migration_stats["migration_ms"] += (
+            time.perf_counter() - t0) * 1e3
+        return new_buckets
+
     def release_space(self, h: SpaceAOIHandle) -> None:
         mig = getattr(h, "_migration", None)
         if mig is not None:
@@ -1036,11 +1243,28 @@ class AOIEngine:
         if not self.flush_sched:
             for bucket in buckets:
                 bucket.dispatch()
+                if getattr(bucket, "_cohort_demote", False):
+                    # aoi.cohort fired at dispatch (before any staging
+                    # mutation): rebuild per-space and republish the SAME
+                    # tick through the fresh solo buckets
+                    for nb in self._demote_cohort(bucket):
+                        nb.flush()
+                    continue  # the torn-down cohort has nothing to harvest
                 bucket.harvest()
         else:
             with _T.span("aoi.dispatch"):
                 for bucket in buckets:
                     bucket.dispatch()
+                demoting = [b for b in buckets
+                            if getattr(b, "_cohort_demote", False)]
+                if demoting:
+                    for b in demoting:
+                        for nb in self._demote_cohort(b):
+                            nb.dispatch()
+                    # re-list: demoted cohorts are gone, their solo
+                    # replacements (already dispatched) must harvest
+                    buckets = [self._buckets[k]
+                               for k in sorted(self._buckets)]
             with _T.span("aoi.harvest"):
                 for bucket in buckets:
                     bucket.harvest()
@@ -1072,6 +1296,12 @@ class AOIEngine:
     @staticmethod
     def _tier_of(bucket) -> str:
         """Placement tier of a live bucket (the _create_handle vocabulary)."""
+        if getattr(bucket, "cohort", False) \
+                or getattr(bucket, "cohort_solo", False):
+            # cohort + demoted-solo buckets are single-chip device tiers;
+            # chip-loss evacuation re-homes their spaces onto the shared
+            # tpu bucket at the same (ladder) capacity -- still stacked
+            return "tpu"
         if getattr(bucket, "exclusive", False):
             return "rowshard"
         name = type(bucket).__name__
@@ -1155,8 +1385,17 @@ class AOIEngine:
                     stats[k] = stats.get(k, 0) + v
             for k, v in getattr(b, "perf", {}).items():
                 perf[k] = perf.get(k, 0.0) + v
+        cohorts = sum(1 for b in self._buckets.values()
+                      if getattr(b, "cohort", False))
+        cohort_spaces = sum(1 for h in self._handles
+                            if not h.released
+                            and getattr(h.bucket, "cohort", False))
         out = [Sample("aoi.buckets", "gauge", len(self._buckets), lbl,
                       "live AOI buckets in this engine"),
+               Sample("aoi.cohorts", "gauge", cohorts, lbl,
+                      "live cohort buckets (space-stacked planes)"),
+               Sample("aoi.cohort_spaces", "gauge", cohort_spaces, lbl,
+                      "spaces currently stacked into cohort buckets"),
                Sample("aoi.calc_level", "gauge", calc_level, lbl,
                       "worst calculator fallback level "
                       "(0=pallas 1=dense 2=host oracle)"),
@@ -1184,6 +1423,16 @@ class AOIEngine:
         out.append(Sample("aoi.migration_ms", "counter",
                           ms["migration_ms"], lbl,
                           "cumulative migration/evacuation wall time (ms)"))
+        cs = self.cohort_stats
+        out.append(Sample("aoi.cohort_joins", "counter", cs["cohort_joins"],
+                          lbl, "spaces stacked into a cohort live"))
+        out.append(Sample("aoi.cohort_leaves", "counter",
+                          cs["cohort_leaves"], lbl,
+                          "spaces un-stacked onto solo buckets"))
+        out.append(Sample("aoi.cohort_demoted_spaces", "counter",
+                          cs["cohort_demoted_spaces"], lbl,
+                          "spaces rebuilt per-space by aoi.cohort "
+                          "demotions"))
         return out
 
     def attach_interest(self, h: SpaceAOIHandle, policies,
@@ -1266,26 +1515,29 @@ class AOIEngine:
             # growth changes the packed layout mid-cover; roll the
             # migration back (zero loss) and let the controller retry
             mig.abort("space grown mid-cover")
+        nh = self.create_space(new_capacity, h.requested or h.backend)
+        # cohort routing may round the new home UP to its ladder shape;
+        # repack to the capacity the new bucket actually allocates
+        target = nh.capacity
         old_words = h.bucket.get_prev(h.slot)
-        ratio = new_capacity // h.capacity
-        if new_capacity == h.capacity * ratio and ratio & (ratio - 1) == 0:
+        ratio = target // h.capacity
+        if target == h.capacity * ratio and ratio & (ratio - 1) == 0:
             # power-of-two growth (every Space growth: capacity doubles):
             # packed word-level column remap, no dense matrix -- the dense
             # path is O(C^2) host BYTES, 17 GB at C=131072 (the oversized
             # capacities the row-sharded calculator serves)
             cap = h.capacity
             words = old_words
-            while cap < new_capacity:
+            while cap < target:
                 words = P.repack_columns_double(words, cap)
                 cap *= 2
-            packed = np.zeros((new_capacity, words.shape[1]), np.uint32)
+            packed = np.zeros((target, words.shape[1]), np.uint32)
             packed[: h.capacity] = words
         else:
             m = P.unpack_rows(old_words, h.capacity)
-            grown = np.zeros((new_capacity, new_capacity), bool)
+            grown = np.zeros((target, target), bool)
             grown[: h.capacity, : h.capacity] = m
             packed = P.pack_rows(grown)
-        nh = self.create_space(new_capacity, h.requested or h.backend)
         nh.bucket.set_prev(nh.slot, packed)
         # carry undelivered events: growth can happen between flush() and
         # dispatch_aoi_events() (e.g. an on_enter_aoi hook spawns entities);
@@ -1297,7 +1549,7 @@ class AOIEngine:
         if stack is not None:
             # the interest stack grows with the space: same planar column
             # remap as the base carry above, then it rides the NEW handle
-            stack.grow(new_capacity)
+            stack.grow(target)
             nh._policy_stack = stack
             h._policy_stack = None
         self.release_space(h)

@@ -50,7 +50,8 @@ import numpy as np
 
 from ..telemetry import trace as _T
 
-__all__ = ["PlacementController", "LoadSample", "MigrationError"]
+__all__ = ["PlacementController", "CohortPlanner", "LoadSample",
+           "MigrationError"]
 
 _EMPTY = np.empty((0, 2), np.int32)
 
@@ -257,6 +258,35 @@ class LoadSample:
     h2d_bytes: float    # staged wire bytes per tick
 
 
+def _load_samples(engine, base: dict, tick: int) -> list:
+    """Per-bucket load since the caller's previous call (deterministic
+    order).  ``base`` is the caller-owned {key: (perf, h2d, tick)} floor;
+    PlacementController and CohortPlanner each keep their own so their
+    sampling windows stay independent."""
+    out = []
+    for key in sorted(engine._buckets):
+        b = engine._buckets[key]
+        perf = sum(getattr(b, "perf", {}).values())
+        h2d = getattr(b, "stats", {}).get("h2d_bytes", 0)
+        base_p, base_h, base_t = base.get(key, (0.0, 0, tick - 1))
+        dt = max(1, tick - base_t)
+        out.append(LoadSample(
+            key=key, tier=engine._tier_of(b),
+            entities=b.n_slots - len(b._free),
+            flush_ms=(perf - base_p) * 1e3 / dt,
+            h2d_bytes=(h2d - base_h) / dt))
+        base[key] = (perf, h2d, tick)
+    return out
+
+
+def _first_live_handle(engine, bucket):
+    live = [h for h in engine._handles
+            if h.bucket is bucket and not h.released
+            and getattr(h, "_migration", None) is None]
+    live.sort(key=lambda h: h.slot)
+    return live[0] if live else None
+
+
 class PlacementController:
     """Scores bucket placement from telemetry counters and executes live
     migrations (Runtime knob ``aoi_placement="static|auto"``).
@@ -311,29 +341,10 @@ class PlacementController:
 
     def load_samples(self) -> list[LoadSample]:
         """Per-bucket load since the previous call (deterministic order)."""
-        eng = self.engine
-        out = []
-        for key in sorted(eng._buckets):
-            b = eng._buckets[key]
-            perf = sum(getattr(b, "perf", {}).values())
-            h2d = getattr(b, "stats", {}).get("h2d_bytes", 0)
-            base_p, base_h, base_t = self._base.get(
-                key, (0.0, 0, self._tick - 1))
-            dt = max(1, self._tick - base_t)
-            out.append(LoadSample(
-                key=key, tier=eng._tier_of(b),
-                entities=b.n_slots - len(b._free),
-                flush_ms=(perf - base_p) * 1e3 / dt,
-                h2d_bytes=(h2d - base_h) / dt))
-            self._base[key] = (perf, h2d, self._tick)
-        return out
+        return _load_samples(self.engine, self._base, self._tick)
 
     def _first_handle(self, bucket):
-        live = [h for h in self.engine._handles
-                if h.bucket is bucket and not h.released
-                and getattr(h, "_migration", None) is None]
-        live.sort(key=lambda h: h.slot)
-        return live[0] if live else None
+        return _first_live_handle(self.engine, bucket)
 
     def decide(self) -> tuple | None:
         """(handle, target_tier) for the single most pressing move, or
@@ -378,4 +389,96 @@ class PlacementController:
                 self.migrate(h, tier)
             except MigrationError:
                 pass  # raced with a release; score again next window
+            self._cooldown = self.cooldown_ticks
+
+
+class CohortPlanner:
+    """Telemetry-driven cohort membership (Runtime knob
+    ``aoi_cohort_planner="static|auto"``, docs/perf.md "Space-stacked
+    cohorts").
+
+    Scores the cohort tier the way :class:`PlacementController` scores
+    bucket tiers -- per-bucket flush-ms deltas from the same counters the
+    telemetry registry exports -- and re-buckets membership live through
+    :meth:`AOIEngine.cohort_join` / :meth:`AOIEngine.cohort_leave` (the
+    snapshot seam; between-flush, bit-exact).  Two rules, both bounded:
+
+      * a cohort whose shared launch exceeds ``hot_ms`` sheds one member
+        per window -- one hot space must not gate the whole cohort's
+        fused launch (per-member attribution is not collected, so the
+        lowest slot goes: shedding ANY member shrinks the launch);
+      * a light solo space -- planner leave and ``aoi.cohort`` fault
+        demotion alike -- folds back into its ladder cohort, so the
+        planner doubles as the demotion re-arm loop.
+
+    Churn discipline: at most ``churn_budget`` moves per decision window
+    and ``cooldown_ticks`` quiet ticks after any move, and target shapes
+    only ever come from the engine's pow2 ladder -- membership churn
+    re-buckets spaces between EXISTING jit keys, so steady-state
+    recompiles stay at 0 (the bench pin)."""
+
+    def __init__(self, engine, mode: str = "static", hot_ms: float = 8.0,
+                 churn_budget: int = 2, cooldown_ticks: int = 32):
+        if mode not in ("static", "auto"):
+            raise ValueError(
+                f"aoi_cohort_planner must be 'static' or 'auto', "
+                f"got {mode!r}")
+        self.engine = engine
+        self.mode = mode
+        self.hot_ms = hot_ms
+        self.churn_budget = churn_budget
+        self.cooldown_ticks = cooldown_ticks
+        self._cooldown = 0
+        self._tick = 0
+        self._base: dict[tuple, tuple] = {}
+
+    def load_samples(self) -> list[LoadSample]:
+        """Per-bucket load since the previous call (own window, so the
+        placement controller's sampling is undisturbed)."""
+        return _load_samples(self.engine, self._base, self._tick)
+
+    def decide(self) -> list[tuple]:
+        """[(handle, "leave"|"join"), ...] for this window, budget-bounded
+        and deterministic (bucket-key order, hot leaves first)."""
+        eng = self.engine
+        samples = self.load_samples()
+        plan: list[tuple] = []
+        for s in samples:
+            if len(plan) >= self.churn_budget:
+                return plan
+            b = eng._buckets.get(s.key)
+            if (b is not None and getattr(b, "cohort", False)
+                    and s.entities > 1 and s.flush_ms > self.hot_ms):
+                h = _first_live_handle(eng, b)
+                if h is not None:
+                    plan.append((h, "leave"))
+        for s in samples:
+            if len(plan) >= self.churn_budget:
+                return plan
+            b = eng._buckets.get(s.key)
+            if (b is not None and getattr(b, "cohort_solo", False)
+                    and s.entities and s.flush_ms * 4 < self.hot_ms):
+                h = _first_live_handle(eng, b)
+                if h is not None:
+                    plan.append((h, "join"))
+        return plan
+
+    def step(self) -> None:
+        """One planner tick (Runtime wires it after placement.step)."""
+        self._tick += 1
+        if self.mode != "auto":
+            return
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return
+        moved = 0
+        for h, action in self.decide():
+            if h.released:
+                continue  # raced with a release inside the window
+            if action == "leave":
+                self.engine.cohort_leave(h)
+            else:
+                self.engine.cohort_join(h)
+            moved += 1
+        if moved:
             self._cooldown = self.cooldown_ticks

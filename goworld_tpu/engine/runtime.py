@@ -58,6 +58,12 @@ class Runtime:
         aoi_placement: str = "static",
         aoi_migration_threshold_ms: float = 5.0,
         aoi_migration_cooldown: int = 64,
+        aoi_cohort=False,
+        aoi_cohort_ladder=None,
+        aoi_cohort_planner: str = "static",
+        aoi_cohort_hot_ms: float = 8.0,
+        aoi_cohort_churn_budget: int = 2,
+        aoi_cohort_cooldown: int = 32,
         aoi_checkpoint: str = "off",
         aoi_checkpoint_interval: int = 16,
         aoi_checkpoint_dir: str | None = None,
@@ -90,7 +96,9 @@ class Runtime:
                              flush_sched=aoi_flush_sched, emit=aoi_emit,
                              paged=aoi_paged, cross_tick=aoi_cross_tick,
                              fused=aoi_fused,
-                             interest_mode=aoi_interest)
+                             interest_mode=aoi_interest,
+                             cohort=aoi_cohort,
+                             cohort_ladder=aoi_cohort_ladder)
         # telemetry-driven placement (engine/placement.py): "static" keeps
         # spaces where capacity routing put them (migrate() stays available
         # as the operator entry point); "auto" re-homes hot/idle spaces
@@ -99,6 +107,19 @@ class Runtime:
             self.aoi, mode=aoi_placement,
             threshold_ms=aoi_migration_threshold_ms,
             cooldown_ticks=aoi_migration_cooldown)
+        # cohort membership planner (engine/placement.py CohortPlanner):
+        # only meaningful with aoi_cohort on; "auto" re-buckets stacked
+        # vs solo spaces live from the same load scores, under a churn
+        # budget, and doubles as the aoi.cohort demotion re-arm loop
+        self.cohort_planner = None
+        if aoi_cohort:
+            from .placement import CohortPlanner
+
+            self.cohort_planner = CohortPlanner(
+                self.aoi, mode=aoi_cohort_planner,
+                hot_ms=aoi_cohort_hot_ms,
+                churn_budget=aoi_cohort_churn_budget,
+                cooldown_ticks=aoi_cohort_cooldown)
         # durable world state (engine/checkpoint.py): "off" costs nothing;
         # "interval"/"continuous" stream per-space incremental checkpoints
         # off the hot path.  Backends come pre-built (aoi_checkpoint_store/
@@ -175,6 +196,10 @@ class Runtime:
         # flush that just ran, and a migration started here snapshots
         # between ticks (no partially-staged state)
         self.placement.step()
+        if self.cohort_planner is not None:
+            # same between-tick discipline as placement: join/leave move
+            # snapshots only after this tick's events are delivered
+            self.cohort_planner.step()
         # checkpoint capture AFTER placement: events for this tick are
         # delivered, migrations are settled, so the export is snapshot-
         # consistent; the expensive half runs on the background writer

@@ -63,6 +63,15 @@ seam                      fires in
                           ``PolicyStack.reset_interest`` (next step is a
                           forced full eval whose diff re-emits the
                           policy transitions deterministically)
+``aoi.cohort``            cohort-bucket health probe at dispatch
+                          (engine/aoi_cohort.py, docs/perf.md
+                          space-stacked cohorts): ANY fired kind
+                          demotes the whole cohort to per-space solo
+                          buckets -- this tick's staged inputs re-stage
+                          and republish same-tick bit-exactly, counted
+                          in ``aoi.cohort_demotions``; the operator
+                          re-arm is ``AOIEngine.recohort`` (demoted
+                          spaces re-stack through the snapshot seam)
 ``aoi.pages``             paged-storage allocator at harvest (paged
                           buckets, docs/perf.md): ``oom``/``fail``/
                           ``partial`` = pool exhaustion -- the bucket
@@ -144,6 +153,10 @@ SEAMS = {
                 "host decode, same-tick bit-exact fallback)",
     "aoi.device": "device health probe at bucket dispatch (reset = chip "
                   "lost; the bucket evacuates to surviving devices)",
+    "aoi.cohort": "cohort-bucket health probe at dispatch (any kind = "
+                  "demote the whole cohort to per-space solo buckets, "
+                  "counted, same-tick bit-exact republish; "
+                  "AOIEngine.recohort re-arms)",
     "aoi.pages": "paged-storage allocator at harvest (oom/fail/partial = "
                  "counted whole-tick spill + pool re-arm; poison = page-"
                  "table corruption caught by validation -> shadow rebuild)",

@@ -1,0 +1,229 @@
+"""Space-stacked cohort planes (ROADMAP #2: one device program for
+thousands of spaces).
+
+PR 15 collapsed one bucket's steady tick to one dispatch; this layer
+collapses *spaces* into buckets.  The packed bucket state already
+carries a leading slot axis (``[S, C, W]`` -- see engine/aoi.py), so a
+slot IS a space row in a shared padded plane: stacking means routing
+many small spaces into one ladder-shaped bucket, exactly like *jaxsgp4*
+batching 10^4 independent propagation problems along a leading axis.
+This module owns the shape discipline and the plane pack/unpack:
+
+* **pow2 shape ladder** (:data:`DEFAULT_LADDER`): cohort capacities
+  come from a short ladder (default 256/1024/4096) so membership churn
+  re-buckets between EXISTING compile keys instead of minting new ones
+  -- the jit key set is O(ladder), never O(spaces).  A space's capacity
+  rounds UP to its ladder shape; the padded tail is inactive, which the
+  predicate ignores bit-exactly (``active=False`` rows/columns never
+  produce interest).
+* **snapshot padding** (:func:`pad_snapshot`): live join rides the
+  existing migration wire image -- a snapshot exported at a space's own
+  capacity repacks losslessly to the ladder shape (planar word remap
+  for pow2 ratios, dense repack otherwise), so the cohort importer is
+  the ordinary ``import_snapshot`` seam.
+* **plane stack/unstack** (:func:`stack_spaces` / :func:`unstack_spaces`):
+  the explicit [S, shape] cohort layout, bit-exact round trip (the
+  property-test surface; the engine's buckets maintain the same planes
+  incrementally).
+* **cohort-cached step** (:func:`cohort_step`): one jitted whole-cohort
+  predicate step per ``(tier, shape)``, memoized in a module-level
+  cache through the :func:`_memo_step` registrar -- the cache idiom the
+  gwlint recompile-churn escape analysis recognizes.
+
+Importing this module never loads jax (the cpu-only processes and
+gwlint itself import the ops package).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import aoi_predicate as P
+from . import dispatch_count as DC
+
+# The pow2 shape ladder: short on purpose.  Every rung is a valid
+# capacity (multiple of P.LANE) and a power of two, so pad_snapshot can
+# always take the word-level planar repack between rungs and the jit
+# compile-key set stays at ~len(ladder) per tier.
+DEFAULT_LADDER = (256, 1024, 4096)
+
+
+def validate_ladder(shapes) -> tuple[int, ...]:
+    """Normalize + validate a cohort shape ladder: ascending powers of
+    two, each a valid capacity (multiple of ``P.LANE``)."""
+    out = tuple(int(s) for s in shapes)  # gwlint: allow[host-sync] -- config ladder ints, never device values
+    if not out:
+        raise ValueError("cohort ladder must not be empty")
+    for s in out:
+        if s & (s - 1) or s % P.LANE:
+            raise ValueError(
+                f"cohort shape {s} must be a power of two multiple of "
+                f"{P.LANE}")
+    if list(out) != sorted(set(out)):
+        raise ValueError(f"cohort ladder must be strictly ascending: {out}")
+    return out
+
+
+def cohort_shape(capacity: int, shapes=DEFAULT_LADDER) -> int | None:
+    """Smallest ladder shape >= capacity, or None (too big to stack --
+    the space keeps its solo/mesh/rowshard routing)."""
+    for s in shapes:
+        if capacity <= s:
+            return s
+    return None
+
+
+def pad_snapshot(snap: dict, shape: int) -> dict:
+    """Repack a migration snapshot (engine/aoi._build_snapshot format) to
+    a larger ladder capacity, losslessly.
+
+    The packet needs no rewrite -- its column indices stay valid at the
+    bigger capacity and the importer scatters into zeros(shape) arrays.
+    The packed interest words repack by the planar word-level column
+    remap for pow2 ratios (the grow_space discipline) and by the dense
+    boolean matrix otherwise (cohort shapes are small; the dense repack
+    is at most shape^2 host bools)."""
+    cap = snap["capacity"]
+    if shape == cap:
+        return snap
+    if shape < cap:
+        raise ValueError(f"cannot shrink snapshot {cap} -> {shape}")
+    words = snap["words"]
+    ratio = shape // cap
+    if shape == cap * ratio and ratio & (ratio - 1) == 0:
+        c = cap
+        while c < shape:
+            words = P.repack_columns_double(words, c)
+            c *= 2
+    else:
+        m = P.unpack_rows(words, cap)
+        grown = np.zeros((cap, shape), bool)
+        grown[:, :cap] = m
+        words = P.pack_rows(grown)
+    padded = np.zeros((shape, words.shape[1]), np.uint32)
+    padded[:cap] = words
+    r = np.zeros(shape, np.float32)
+    r[:cap] = snap["r"]
+    act = np.zeros(shape, bool)
+    act[:cap] = snap["act"]
+    return {"capacity": shape, "packet": snap["packet"], "r": r,
+            "act": act, "sub": snap["sub"], "words": padded}
+
+
+def _positions(snap: dict, shape: int) -> tuple[np.ndarray, np.ndarray]:
+    """Dense [shape] x/z from a snapshot's delta packet (the packet's
+    column indices are < snap capacity <= shape)."""
+    x = np.zeros(shape, np.float32)
+    z = np.zeros(shape, np.float32)
+    if snap["packet"] is not None:
+        _rows, cols, xv, zv = snap["packet"]
+        x[cols] = xv
+        z[cols] = zv
+    return x, z
+
+
+def stack_spaces(snaps: list[dict], shape: int) -> dict:
+    """Stack per-space snapshots into explicit cohort planes with a
+    leading space axis: ``{"x","z","r": f32[S, shape], "act": bool[S,
+    shape], "sub": bool[S], "words": u32[S, shape, W]}``.  Each space
+    pads to ``shape``; the padded tail is inactive and all-zero."""
+    s_n = len(snaps)
+    w = P.words_per_row(shape)
+    planes = {"x": np.zeros((s_n, shape), np.float32),
+              "z": np.zeros((s_n, shape), np.float32),
+              "r": np.zeros((s_n, shape), np.float32),
+              "act": np.zeros((s_n, shape), bool),
+              "sub": np.zeros(s_n, bool),
+              "words": np.zeros((s_n, shape, w), np.uint32)}
+    for s, snap in enumerate(snaps):
+        p = pad_snapshot(snap, shape)
+        x, z = _positions(snap, shape)
+        planes["x"][s] = x
+        planes["z"][s] = z
+        planes["r"][s] = p["r"]
+        planes["act"][s] = p["act"]
+        planes["sub"][s] = p["sub"]
+        planes["words"][s] = p["words"]
+    return planes
+
+
+def unstack_spaces(planes: dict, caps: list[int]) -> list[dict]:
+    """Inverse of :func:`stack_spaces`: slice each space row back to its
+    own capacity, bit-exactly (padded tails are zero by construction, so
+    truncation loses nothing)."""
+    from ..ops import aoi_stage as AS
+
+    shape = planes["x"].shape[1]
+    out = []
+    for s, cap in enumerate(caps):
+        if cap > shape:
+            raise ValueError(f"space capacity {cap} exceeds plane {shape}")
+        x = np.ascontiguousarray(planes["x"][s, :cap])
+        z = np.ascontiguousarray(planes["z"][s, :cap])
+        m = P.unpack_rows(planes["words"][s], shape)
+        words = P.pack_rows(np.ascontiguousarray(m[:cap, :cap]))
+        nz = np.nonzero((x.view(np.uint32) != 0)
+                        | (z.view(np.uint32) != 0))[0]
+        pkt = None
+        if len(nz):
+            pkt = tuple(np.ascontiguousarray(a) for a in AS.pad_packet(
+                np.zeros(len(nz), np.int64), nz, x[nz], z[nz]))
+        out.append({"capacity": cap, "packet": pkt,
+                    "r": np.array(planes["r"][s, :cap], np.float32,
+                                  copy=True),
+                    "act": np.array(planes["act"][s, :cap], bool,
+                                    copy=True),
+                    "sub": bool(planes["sub"][s]),
+                    "words": words})
+    return out
+
+
+# -- the cohort-cached jit step ----------------------------------------------
+#
+# One compiled whole-cohort predicate step per (tier, shape): every
+# cohort of the same shape on the same tier shares the program, so
+# planner re-bucketing (membership churn between ladder rungs) never
+# recompiles.  The cache lives at module level and is filled through
+# the _memo_step registrar -- the escape idiom the gwlint
+# recompile-churn rule accepts as memoization evidence.
+
+_STEP_CACHE: dict = {}
+
+
+def _memo_step(key, fn):
+    """Register a compiled cohort step under its ``(tier, shape)`` key
+    and hand it back -- the single write point of the module cache."""
+    _STEP_CACHE[key] = fn
+    return fn
+
+
+def cohort_step(tier: str, shape: int):
+    """The jitted whole-cohort step for ``(tier, shape)``: stacked
+    ``(x, z, r, act, prev)`` planes in, ``(new, chg)`` packed interest
+    planes out, one program launch for the entire cohort.  Memoized per
+    key; callers must :func:`dispatch_count.record` beside the call."""
+    key = (tier, shape)
+    fn = _STEP_CACHE.get(key)
+    if fn is not None:
+        return fn
+    import jax
+
+    from .aoi_dense import aoi_step_chg
+
+    def step(x, z, r, act, prev):
+        return aoi_step_chg(x, z, r, act, prev)
+
+    return _memo_step(key, jax.jit(step))
+
+
+def run_cohort_step(tier: str, shape: int, planes: dict):
+    """Convenience driver for smokes/tests: one launch over explicit
+    planes, returning host (new, chg) uint32 arrays.  The launch is
+    recorded in dispatch_count and its compile key in the recompile
+    meter (``DC.record_key``)."""
+    fn = cohort_step(tier, shape)
+    DC.record()
+    DC.record_key("aoi.cohort_step", (tier, shape, planes["x"].shape[0]))
+    new, chg = fn(planes["x"], planes["z"], planes["r"], planes["act"],
+                  planes["words"])
+    return np.asarray(new), np.asarray(chg)  # gwlint: allow[host-sync] -- smoke/test driver, not the flush hot path

@@ -51,6 +51,7 @@ from __future__ import annotations
 
 from . import aoi_pages as PG
 from . import aoi_stage as AS
+from . import dispatch_count as DC
 from . import events as EV
 
 _tri_impl = None
@@ -104,6 +105,11 @@ def fused_tri_step(prev_all, new_buf, chg_buf, tri_buf, x_all, z_all,
                     count.reshape(1), x_all, z_all)
 
         _tri_impl = impl
+    # compile-key meter (steady-state recompiles = 0 pins): the static
+    # args + every donated shape ARE the jit cache key
+    DC.record_key("aoi.fused_tri", (prev_all.shape, new_buf.shape,
+                                    tri_buf.shape, rows.shape,
+                                    max_triples, platform))
     return _tri_impl(prev_all, new_buf, chg_buf, tri_buf, x_all, z_all,
                      rows, cols, xv, zv, slot_idx, r_all, act_all,
                      sub_all, max_triples, platform=platform)
@@ -164,6 +170,10 @@ def fused_paged_step(prev_all, new_buf, chg_buf, pg_buf, pc_buf,
                     free_next, bundle, x_all, z_all)
 
         _paged_impl = impl
+    DC.record_key("aoi.fused_paged", (prev_all.shape, new_buf.shape,
+                                      pg_buf.shape, rows.shape,
+                                      page_words, bin_words, max_spill,
+                                      platform))
     return _paged_impl(prev_all, new_buf, chg_buf, pg_buf, pc_buf,
                        pn_buf, free, x_all, z_all, rows, cols, xv, zv,
                        slot_idx, r_all, act_all, sub_all, page_words,

@@ -133,6 +133,7 @@ def apply_packet(dx, dz, rows, cols, xv, zv):
         _apply_impl = impl
     _th = _T.t()
     DC.record()
+    DC.record_key("aoi.apply_packet", (dx.shape, rows.shape))
     out = _apply_impl(dx, dz, rows, cols, xv, zv)
     _T.lap("aoi.h2d", _th)
     return out

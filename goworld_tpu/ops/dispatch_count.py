@@ -25,6 +25,8 @@ recording is a plain increment, so the counter is safe inside
 from __future__ import annotations
 
 _n = 0
+_keys: set = set()
+_new_keys = 0
 
 
 def record(n=1):
@@ -42,3 +44,42 @@ def reset():
     """Zero the counter (test/smoke harness hook)."""
     global _n
     _n = 0
+
+
+def record_key(site: str, key) -> bool:
+    """Record the jit compile key a launch site is about to call with.
+
+    Every call site in this repo reaches XLA through a module-level
+    memoized wrapper, so a *recompile* happens exactly when a site sees
+    a ``(static args, shapes)`` combination for the first time.  Sites
+    report that combination here (hashable, host-side), and the
+    steady-state-recompiles-=-0 pins (bench engine_multispace,
+    scripts/multispace_smoke.py) bracket the measured window with
+    :func:`reset_keys` / :func:`new_keys`.  Returns True when the key is
+    new since the last :func:`clear_keys` (i.e. this call compiles)."""
+    global _new_keys
+    k = (site, key)
+    if k in _keys:
+        return False
+    _keys.add(k)
+    _new_keys += 1
+    return True
+
+
+def new_keys() -> int:
+    """Fresh compile keys observed since the last :func:`reset_keys`."""
+    return _new_keys
+
+
+def reset_keys():
+    """Zero the new-key counter, KEEPING the seen set -- the warmup/
+    measure bracket (warm keys must not count as steady recompiles)."""
+    global _new_keys
+    _new_keys = 0
+
+
+def clear_keys():
+    """Forget every seen key (full harness reset between configs)."""
+    global _new_keys
+    _keys.clear()
+    _new_keys = 0

@@ -90,7 +90,15 @@ JAX_PLATFORMS=cpu python scripts/loadgen_smoke.py || fail=1
 echo "== fused smoke =="
 JAX_PLATFORMS=cpu python scripts/fused_smoke.py || fail=1
 
-# 14. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+# 14. space-stacked cohort smoke (CPU backend): a 24-small-spaces shard
+#    stacked into ONE cohort bucket vs per-space solo buckets vs the
+#    oracle -- bit-exact parity, 1 dispatch/tick vs 24, zero new jit
+#    keys after warmup, forced aoi.cohort demotion + recohort re-arm
+#    (docs/perf.md "Space-stacked cohorts")
+echo "== multispace smoke =="
+JAX_PLATFORMS=cpu python scripts/multispace_smoke.py || fail=1
+
+# 15. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
 #    over every declared seam, bit-exact parity + zero stuck buckets
 #    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
 if [ "${GW_SOAK:-0}" = "1" ]; then
@@ -101,7 +109,7 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 15. native fan-out under ASan/UBSan -- opt-in (GW_SANITIZE=1): rebuild
+# 16. native fan-out under ASan/UBSan -- opt-in (GW_SANITIZE=1): rebuild
 #    the .san.so variants and re-run the emit-path smoke with the
 #    sanitizer runtimes preloaded (same env recipe as
 #    tests/test_native_sanitize.py; docs/perf.md emit paths)
@@ -123,7 +131,7 @@ else
     echo "== emit smoke (ASan/UBSan) == (opt-in; GW_SANITIZE=1 to run)"
 fi
 
-# 16. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 17. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

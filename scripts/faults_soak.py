@@ -15,6 +15,9 @@ is the whole self-healing story at once, seams interacting:
   ``calc_level == 0`` with no pending repair and parity intact;
 * the fused one-launch pipeline (``aoi_fused``) demotes per-tick when a
   seam fires inside the attempt -- counted, bit-exact, self-re-engaging;
+* the space-stacked cohort (``aoi.cohort`` seam) demotes the whole
+  shared bucket to per-space solo buckets same-tick when its dispatch
+  faults, and the operator re-arm (``recohort()``) stacks them back;
 * the connection seams get the same treatment against a live socket:
   injected resets on flush/connect must still deliver every payload
   exactly once, in order, with the outage buffer drained.
@@ -204,6 +207,84 @@ def soak_fused(seed: int, cap=256, n=200, ticks=10) -> dict:
         return {"fired": len(plan.fired),
                 "fused": st["fused_dispatches"],
                 "demoted": st["fused_demotions"]}
+    finally:
+        faults.clear()
+
+
+def soak_cohort(seed: int, ticks=10) -> dict:
+    """The ``aoi.cohort`` round: several small spaces stacked into ONE
+    ladder-shaped cohort bucket (``AOIEngine(cohort="auto")``, docs/
+    perf.md "Space-stacked cohorts") walk next to per-space CPU oracles
+    under an ``aoi.cohort`` spec pinned mid-walk.  The seam firing on
+    the shared dispatch must demote the WHOLE cohort to per-space solo
+    buckets that same tick -- counted, republished, bit-exact -- and
+    demotion is sticky by design: the operator re-arm is plan cleared +
+    ``recohort()``, after which two clean ticks prove the spaces are
+    stacked and dispatching fused again.  All capacities draw from one
+    rung so the single cohort bucket's per-flush seam probe maps 1:1
+    onto ticks and the pinned occurrence provably fires."""
+    rng = np.random.default_rng(seed)
+    caps = [int(rng.integers(1, 3)) * 128 for _ in range(4)]  # rung 256
+    kind = ["oom", "fail", "reset"][int(rng.integers(3))]
+    at = int(rng.integers(3, ticks))
+
+    oracle = AOIEngine(default_backend="cpu")
+    ohs = [oracle.create_space(c) for c in caps]
+    plan = faults.FaultPlan(seed=seed)
+    plan.add("aoi.cohort", kind, at=at)
+    faults.install(plan)
+    try:
+        eng = AOIEngine(default_backend="tpu", cohort="auto",
+                        cohort_ladder=(256,))
+        hs = [eng.create_space(c) for c in caps]
+        assert len({h.bucket for h in hs}) == 1, \
+            f"caps {caps} did not stack on one rung seed={seed}"
+        scenes = []
+        for c in caps:
+            x = rng.uniform(0, 600, c).astype(np.float32)
+            z = rng.uniform(0, 600, c).astype(np.float32)
+            r = rng.uniform(60, 120, c).astype(np.float32)
+            act = np.ones(c, bool)
+            scenes.append([x, z, r, act])
+        for t in range(ticks + 2):
+            if t == ticks:  # operator re-arm: demotion is sticky
+                faults.clear()
+                restacked = eng.recohort()
+                assert restacked == len(caps), \
+                    f"recohort moved {restacked} != {len(caps)} seed={seed}"
+            for sc in scenes:
+                sc[0] = np.clip(sc[0] + rng.uniform(-20, 20, len(sc[0])),
+                                0, 600).astype(np.float32)
+                sc[1] = np.clip(sc[1] + rng.uniform(-20, 20, len(sc[1])),
+                                0, 600).astype(np.float32)
+            for h, oh, sc in zip(hs, ohs, scenes):
+                eng.submit(h, *sc)
+                oracle.submit(oh, *sc)
+            eng.flush()
+            oracle.flush()
+            for i, (h, oh) in enumerate(zip(hs, ohs)):
+                e, l = eng.take_events(h)
+                ce, cl = oracle.take_events(oh)
+                np.testing.assert_array_equal(
+                    e, ce, err_msg=f"enter t={t} space={i} seed={seed}")
+                np.testing.assert_array_equal(
+                    l, cl, err_msg=f"leave t={t} space={i} seed={seed}")
+        assert len(plan.fired) == 1, \
+            f"pinned aoi.cohort spec never fired seed={seed}: {plan.fired}"
+        demoted = eng.cohort_stats["cohort_demoted_spaces"]
+        assert demoted == len(caps), \
+            f"demotion missed spaces seed={seed}: {eng.cohort_stats}"
+        # after the re-arm every space is back on ONE shared cohort
+        # bucket and its fused dispatch ran both clean ticks
+        buckets = {h.bucket for h in hs}
+        assert len(buckets) == 1, f"recohort left strays seed={seed}"
+        st = dict(next(iter(buckets)).stats)
+        assert getattr(next(iter(buckets)), "cohort", False), \
+            f"re-armed bucket is not a cohort seed={seed}"
+        assert st["cohort_dispatches"] >= 2, \
+            f"cohort path never re-engaged seed={seed}: {st}"
+        return {"kind": kind, "at": at, "demoted": demoted,
+                "redispatched": st["cohort_dispatches"]}
     finally:
         faults.clear()
 
@@ -549,6 +630,7 @@ def main(argv):
         xt = bool(i % 2)
         a = soak_aoi(seed, cross_tick=xt)
         f = soak_fused(seed)
+        co = soak_cohort(seed)
         g = soak_ingest(seed)
         it = soak_interest(seed)
         c = soak_checkpoint(seed)
@@ -559,6 +641,8 @@ def main(argv):
               f"host_ticks={a['stats']['host_ticks']} "
               f"page_spills={a['stats']['page_spills']} | "
               f"fused n={f['fused']} demoted={f['demoted']} | "
+              f"cohort {co['kind']}@{co['at']} demoted={co['demoted']} "
+              f"restacked={co['redispatched']} | "
               f"ingest {g['kind']} demoted={g['demoted']} "
               f"batched={g['batched']} | "
               f"interest {it['kind']}@{it['at']} "
@@ -568,7 +652,8 @@ def main(argv):
               f"disp fired={d['fired']} replayed={d['replayed']} -- "
               f"bit-exact, no stuck buckets")
     print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.fused "
-          f"demotion, aoi.ingest, aoi.interest and store.*, parity held)")
+          f"and aoi.cohort demotion, aoi.ingest, aoi.interest and "
+          f"store.*, parity held)")
     return 0
 
 

@@ -55,7 +55,7 @@ def test_seam_catalog_stable():
     assert set(faults.SEAMS) == {
         "aoi.grow", "aoi.h2d", "aoi.delta", "aoi.kernel", "aoi.scalars",
         "aoi.fetch", "aoi.emit", "aoi.device", "aoi.pages", "aoi.ingest",
-        "aoi.interest", "conn.send", "conn.flush", "conn.recv",
+        "aoi.interest", "aoi.cohort", "conn.send", "conn.flush", "conn.recv",
         "disp.connect", "bench.config", "store.write", "store.read",
         "store.manifest"}
     assert set(faults.KINDS) == {

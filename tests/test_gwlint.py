@@ -1117,6 +1117,59 @@ def test_recompile_churn_suppression_file(tmp_path):
     assert findings == [] and errors == []
 
 
+COHORT_CACHE = """\
+    import jax
+
+    _STEPS = {}
+
+    def _memo_step(key, fn):
+        _STEPS[key] = fn
+        return fn
+
+    def cohort_step(tier, shape):
+        fn = _STEPS.get((tier, shape))
+        if fn is not None:
+            return fn
+        def step(x):
+            return x * 2
+        return _memo_step((tier, shape), jax.jit(step))
+
+    def registrar_kw(shape):
+        def step(x):
+            return x * 2
+        return _memo_step(key=shape, fn=jax.jit(step))
+
+    def invoked(xs):
+        def step(x):
+            return x
+        return jax.jit(step)(xs)
+
+    def alias_invoked(xs):
+        def step(x):
+            return x
+        fn = jax.jit(step)
+        return fn(xs)
+"""
+
+
+def test_recompile_churn_registrar_call_is_memo_evidence(tmp_path):
+    """The ops/aoi_cohort cohort-cache idiom: handing the fresh wrapper
+    to a plain registrar call (positional or keyword) counts as memo
+    evidence -- but INVOKING it (func position, directly or through an
+    alias) still flags."""
+    _mk(tmp_path, {"ops/cohort.py": COHORT_CACHE})
+    findings, _ = _run(tmp_path, [recompile_churn.check])
+    lines = {f.line for f in findings}
+    clean = "_memo_step((tier, shape), jax.jit(step))"
+    assert _ln(COHORT_CACHE, clean) not in lines
+    assert _ln(COHORT_CACHE, "fn=jax.jit(step))") not in lines
+    assert _ln(COHORT_CACHE, "jax.jit(step)(xs)") in lines
+    assert _ln(COHORT_CACHE, "fn = jax.jit(step)") in lines
+    assert len(findings) == 2, "\n".join(f.render() for f in findings)
+    assert all(f.rule == "recompile-churn"
+               and "no memoization" in f.message for f in findings)
+
+
 # -- thread-discipline -------------------------------------------------------
 
 TD_WRITER = """\
