@@ -2361,6 +2361,61 @@ def bench_engine_restart(cfg, ticks=32, kill_at=20, cap=1024):
     }
 
 
+def bench_engine_failover_host(cfg, ticks=48, kill_at=24, cap=256):
+    """kill -9 a live game PROCESS under a real dispatcher
+    (docs/robustness.md "Cluster supervision & host failover"): two
+    worker processes each own one space and journal per-tick event crcs;
+    one is SIGKILLed mid-load.  The dispatcher fences the dead ownership
+    epoch and re-homes its space onto the survivor from the shared
+    checkpoint store, then replays the buffered client movement.  The
+    merged delivered stream (crash journal + survivor's resume journal)
+    must equal the unkilled oracle's per-tick crc32s exactly
+    (events_lost MUST be 0) and ticks_to_recover is reported."""
+    import shutil
+    import tempfile
+
+    from goworld_tpu.engine.failover import host_failover_scenario
+
+    d = tempfile.mkdtemp(prefix="gw_bench_failover_")
+    try:
+        out = host_failover_scenario(d, cap=cap, world=cfg.world,
+                                     ticks=ticks, kill_at=kill_at,
+                                     tier="cpu", lease_ttl_s=2.0,
+                                     pace_s=0.01)
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return {
+        "metric": "engine_failover_host",
+        "config": "engine_failover_host",
+        "kind": "kill -9 host failover recovery",
+        "value": out["ticks_to_recover"],
+        "unit": "ticks",
+        "rate_kind": "recovery",
+        "detail": f"SIGKILL one of 2 game processes at tick {kill_at} of "
+                  f"{ticks}, 2 spaces x {cap} entities, r=100.0, "
+                  f"world={cfg.world}; lease-fenced failover, survivor "
+                  f"restores from shared checkpoints + bounded replay vs "
+                  f"unkilled oracle, per-tick crc32 parity",
+        "n_entities": 2 * cap,
+        "ticks": ticks,
+        "kill_tick": out["kill_tick"],
+        "killed_tick": out["killed_tick"],
+        "restored_tick": out["restored_tick"],
+        "ticks_to_recover": out["ticks_to_recover"],
+        "replayed_overlap_ticks": out["replayed_overlap_ticks"],
+        "events_lost": out["events_lost"],
+        "parity_ok": out["parity_ok"],
+        "replay_parity_ok": out["replay_parity_ok"],
+        "survivor_space_ok": out["survivor_space_ok"],
+        "recover_wall_s": round(out["recover_wall_s"], 2),
+        "oracle_events": out["oracle_events"],
+        "leases": out["clu_stats"]["leases"],
+        "failovers": out["clu_stats"]["failovers"],
+        "fenced_packets": out["clu_stats"]["fenced_packets"],
+        "replayed_moves": out["clu_stats"]["replayed_moves"],
+    }
+
+
 def bench_cpu(cfg, xs, zs):
     """CPU baseline: the native C++ sweep calculator when buildable (the
     fair equivalent of the reference's compiled go-aoi XZList), else the
@@ -2657,6 +2712,14 @@ def main():
                 # per-tick crc parity against the uncrashed oracle)
                 emit(bench_engine_ckpt(cfg))
                 emit(bench_engine_restart(cfg))
+                # kill -9 a whole HOST (one of two real game worker
+                # processes under a live dispatcher): lease-fenced
+                # failover re-homes its space onto the survivor from the
+                # shared checkpoint store, replays the dispatcher-
+                # buffered movement, and the merged stream must be
+                # crc-equal to the unkilled oracle (docs/robustness.md
+                # "Cluster supervision & host failover")
+                emit(bench_engine_failover_host(cfg))
                 import jax
 
                 if jax.default_backend() != "tpu":

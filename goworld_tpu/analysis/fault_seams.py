@@ -40,6 +40,11 @@ RULE = "fault-seam-coverage"
 # reads cannot is untestable durability
 FAMILIES = {
     "store": ("store.write", "store.read", "store.manifest"),
+    # cluster supervision (docs/robustness.md "Cluster supervision & host
+    # failover"): a lease that can stall but whose failover restore cannot
+    # fault -- or a zombie probe without the kill seam -- tests only half
+    # the kill-a-host story
+    "clu": ("clu.lease", "clu.kill", "clu.zombie", "clu.restore"),
 }
 
 

@@ -28,6 +28,7 @@ from ... import consts, telemetry
 from ...config import ClusterConfig
 from ...netutil import Packet, PacketConnection, serve_tcp
 from ...proto import msgtypes as MT
+from ...telemetry import trace
 from ...utils import binutil, gwlog, gwvar, opmon
 
 from ...consts import (  # noqa: F401  (module aliases kept for callers)
@@ -57,6 +58,29 @@ class _GameInfo:
     pending: deque = field(default_factory=deque)
     frozen: bool = False
     load: float = 0.0
+    # cluster supervision (lease_ttl_s > 0): the monotonically increasing
+    # ownership epoch, bumped on every registration AND every failover --
+    # packets from a peer stamped with an older epoch are fenced
+    epoch: int = 0
+    # injectable-clock deadline of the current lease; 0 = no lease granted
+    lease_deadline: float = 0.0
+    # space ids the game reported with its last renewal: the re-homing
+    # inventory the survivor restores from the shared checkpoint store
+    spaces: tuple = ()
+
+
+# supervision telemetry (docs/observability.md "Cluster supervision")
+_LEASES = telemetry.counter(
+    "clu.leases", "game lease renewals accepted by the dispatcher")
+_FAILOVERS = telemetry.counter(
+    "clu.failovers", "dead-game failovers orchestrated (lease expiry, or "
+    "disconnect with leases armed)")
+_FENCED = telemetry.counter(
+    "clu.fenced_packets", "stale-epoch (zombie/split-brain) game packets "
+    "fenced: counted, dropped, sender told to shut down")
+_REPLAYED = telemetry.counter(
+    "clu.replayed_moves", "buffered client movement batches replayed to "
+    "failover survivors")
 
 
 class _Peer:
@@ -67,6 +91,10 @@ class _Peer:
         self.kind = "?"  # "game" | "gate"
         self.id = 0
         self.alive = True
+        # ownership epoch stamped at registration; compared against the
+        # _GameInfo epoch on every packet when leases are armed
+        self.epoch = 0
+        self.shutdown_sent = False
 
     def send(self, p: Packet, release=False):
         if self.alive:
@@ -84,7 +112,7 @@ class _Peer:
 
 
 class DispatcherService:
-    def __init__(self, disp_id: int, cfg: ClusterConfig):
+    def __init__(self, disp_id: int, cfg: ClusterConfig, now=time.monotonic):
         self.id = disp_id
         self.cfg = cfg
         dc = cfg.dispatchers[disp_id]
@@ -104,6 +132,19 @@ class DispatcherService:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.log = gwlog.logger(f"dispatcher{disp_id}")
+        # cluster supervision (docs/robustness.md "Cluster supervision &
+        # host failover").  ``now`` is the injectable liveness clock -- all
+        # lease grants, renewals and expiry sweeps read it, so fake-clock
+        # tests drive the whole failover state machine with zero sleeps.
+        self.now = now
+        self._lease_ttl = float(dc.lease_ttl_s)
+        # per-game bounded deque of regrouped client-movement payloads kept
+        # for failover replay; only populated while leases are armed
+        self._move_buffer: dict[int, deque] = {}
+        # plain mirrors of the clu.* telemetry counters, always on (the
+        # instruments are no-ops while telemetry is disabled)
+        self.clu_stats = {"leases": 0, "failovers": 0,
+                          "fenced_packets": 0, "replayed_moves": 0}
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -162,6 +203,8 @@ class DispatcherService:
             if now >= flush_deadline:
                 self._flush_all()
                 self._check_unblock(now)
+                if self._lease_ttl > 0:
+                    self._sweep_leases(self.now())
                 flush_deadline = now + 0.005
 
     def _flush_all(self):
@@ -181,6 +224,20 @@ class DispatcherService:
     # -- handlers ----------------------------------------------------------
     def _handle(self, peer: _Peer, pkt: Packet):
         msgtype = pkt.read_u16()
+        # epoch fence (leases armed): a game peer whose stamped epoch is
+        # older than the directory's current epoch is a zombie -- a process
+        # presumed dead (lease expired, spaces re-homed) that stalled and
+        # resumed.  Its packets must not reach any handler: the directory
+        # now routes its entities elsewhere, so delivering would double-
+        # apply events.  Count, drop, tell it to shut down.  A fresh
+        # MT_SET_GAME_ID is exempt -- re-registration is the re-admission
+        # path and stamps a new epoch.
+        if (self._lease_ttl > 0 and peer.kind == "game"
+                and msgtype != MT.MT_SET_GAME_ID):
+            gi = self.games.get(peer.id)
+            if gi is not None and peer.epoch != gi.epoch:
+                self._fence(peer, msgtype)
+                return
         if MT.is_redirect_to_client(msgtype) or msgtype == MT.MT_SYNC_POSITION_YAW_ON_CLIENTS:
             gate_id = pkt.read_u16()
             gate = self.gates.get(gate_id)
@@ -201,6 +258,17 @@ class DispatcherService:
         peer.kind, peer.id = "game", gid
         gi = self.games.setdefault(gid, _GameInfo())
         gi.conn = peer
+        if self._lease_ttl > 0:
+            # stamp a fresh ownership epoch and grant the first lease; any
+            # older peer still claiming this gid is fenced from here on
+            gi.epoch += 1
+            peer.epoch = gi.epoch
+            peer.shutdown_sent = False
+            gi.lease_deadline = self.now() + self._lease_ttl
+            grant = Packet.for_msgtype(MT.MT_GAME_LEASE_GRANT)
+            grant.append_u32(gi.epoch)
+            grant.append_f32(self._lease_ttl)
+            peer.send(grant)
         # reconcile directory: entities the game claims that now map to a
         # DIFFERENT live game are rejected back so the claimer destroys its
         # duplicate (reference: DispatcherService.go:376-398); dead or
@@ -374,6 +442,118 @@ class DispatcherService:
         if gi:
             gi.load = load
 
+    # -- cluster supervision: leases / fencing / failover ------------------
+    def _h_game_lease_renew(self, peer, pkt):
+        gid = pkt.read_u16()
+        epoch = pkt.read_u32()
+        n = pkt.read_u32()
+        spaces = tuple(pkt.read_varstr() for _ in range(n))
+        gi = self.games.get(gid)
+        if gi is None or gi.conn is not peer or epoch != gi.epoch:
+            # a renewal racing its own failover (stale epoch from a peer
+            # the fence has not seen yet) must not resurrect the lease
+            return
+        gi.lease_deadline = self.now() + self._lease_ttl
+        gi.spaces = spaces
+        self.clu_stats["leases"] += 1
+        _LEASES.inc()
+
+    def _fence(self, peer: _Peer, msgtype: int):
+        """Drop one stale-epoch packet and (once) tell the zombie to die."""
+        self.clu_stats["fenced_packets"] += 1
+        _FENCED.inc()
+        if not peer.shutdown_sent:
+            peer.shutdown_sent = True
+            self.log.warning(
+                "fencing zombie game%d (stale epoch %d, msgtype %d): "
+                "sending shutdown", peer.id, peer.epoch, msgtype)
+            peer.send(Packet.for_msgtype(MT.MT_GAME_SHUTDOWN))
+
+    def _sweep_leases(self, now: float):
+        """Fail over every registered game whose lease deadline passed.
+        Runs on the dispatcher thread at the flush cadence; fake-clock
+        tests call it directly with a synthetic ``now``."""
+        for gid in sorted(self.games):
+            gi = self.games[gid]
+            if gi.conn is None or gi.frozen or not gi.lease_deadline:
+                continue
+            if now >= gi.lease_deadline:
+                self.log.warning("game%d lease expired; failing over", gid)
+                self._fail_over_game(gid)
+
+    def _purge_dead_game(self, gid: int) -> int:
+        """Broadcast the death and release the dead game's service
+        registrations (cluster-singleton failover).  Returns the number of
+        services released.  Shared by the classic disconnect path and the
+        lease-failover path."""
+        out = Packet.for_msgtype(MT.MT_NOTIFY_GAME_DISCONNECTED)
+        out.append_u16(gid)
+        self._broadcast_games(out, exclude=gid)
+        stale = [s for s, g in self._srvdis_owner.items() if g == gid]
+        for srvid in stale:
+            del self._srvdis_owner[srvid]
+            self.srvdis.pop(srvid, None)
+            self._broadcast_games(
+                self._srvdis_update_pkt(srvid, ""), exclude=gid
+            )
+        return len(stale)
+
+    def _fail_over_game(self, gid: int):
+        """Re-home a dead game's spaces onto the least-loaded survivor.
+
+        Runs atomically on the dispatcher thread: bump the ownership epoch
+        (fencing any zombie), clean the directory, pick a survivor, send it
+        MT_REHOME_SPACES (restore from the shared checkpoint store) then
+        MT_REPLAY_MOVES (the buffered client movement since the last
+        consistent epoch), and re-point the dead game's directory entries.
+        Per-connection TCP ordering guarantees the survivor processes
+        rehome -> replay -> re-routed live traffic in that order."""
+        gi = self.games.get(gid)
+        if gi is None:
+            return
+        with trace.span("clu.failover"):
+            gi.conn = None
+            gi.lease_deadline = 0.0
+            gi.epoch += 1
+            dead = sorted(eid for eid, ei in self.entities.items()
+                          if ei.game_id == gid)
+            released = self._purge_dead_game(gid)
+            survivor = self._pick_least_loaded_game()
+            buf = self._move_buffer.pop(gid, None)
+            if survivor == 0:
+                for eid in dead:
+                    del self.entities[eid]
+                self.log.error(
+                    "game%d died with no survivor: %d entities dropped, "
+                    "%d services released", gid, len(dead), released)
+                return
+            out = Packet.for_msgtype(MT.MT_REHOME_SPACES)
+            out.append_u16(gid)
+            out.append_u32(gi.epoch)
+            out.append_u32(len(gi.spaces))
+            for sid in gi.spaces:
+                out.append_varstr(sid)
+            self._send_to_game(survivor, out)
+            if buf:
+                rp = Packet.for_msgtype(MT.MT_REPLAY_MOVES)
+                rp.append_u16(gid)
+                rp.append_u32(len(buf))
+                for payload in buf:
+                    rp.append_varbytes(payload)
+                self._send_to_game(survivor, rp)
+                self.clu_stats["replayed_moves"] += len(buf)
+                _REPLAYED.inc(len(buf))
+            for eid in dead:
+                self.entities[eid].game_id = survivor
+            self.clu_stats["failovers"] += 1
+            _FAILOVERS.inc()
+            self.log.info(
+                "game%d failed over to game%d: %d spaces re-homed, %d "
+                "entities re-pointed, %d move batches replayed, %d "
+                "services released", gid, survivor, len(gi.spaces),
+                len(dead), len(buf) if buf else 0, released)
+            gi.spaces = ()
+
     def _h_call_entity_method(self, peer, pkt):
         eid = pkt.read_entity_id()
         self._dispatch_entity_packet(eid, pkt)
@@ -454,6 +634,16 @@ class DispatcherService:
             out.append_entity_id(eid)
             out.append_bytes(rec)
         for gid, out in per_game.items():
+            if self._lease_ttl > 0:
+                # buffer the regrouped batch for failover replay -- kept
+                # even when delivery succeeds, because the owner may die
+                # after the send but before applying it.  The survivor
+                # dedups replay against its restored checkpoint tick.
+                buf = self._move_buffer.get(gid)
+                if buf is None:
+                    buf = deque(maxlen=max(1, self.dispcfg.lease_replay_cap))
+                    self._move_buffer[gid] = buf
+                buf.append(bytes(out.payload))
             self._send_to_game(gid, out)
 
     # -- migration ---------------------------------------------------------
@@ -625,11 +815,18 @@ class DispatcherService:
         if peer.kind == "game":
             gi = self.games.get(peer.id)
             if gi and gi.conn is peer:
-                gi.conn = None
                 if gi.frozen:
                     # freeze in progress: keep queueing until restore
+                    gi.conn = None
                     self.log.info("game%d frozen, awaiting restore", peer.id)
                     return
+                if self._lease_ttl > 0:
+                    # leases armed: a dropped connection is a death signal
+                    # too -- same orchestration as lease expiry, just
+                    # detected sooner
+                    self._fail_over_game(peer.id)
+                    return
+                gi.conn = None
                 # clean directory; notify everyone
                 # (reference: :595-643)
                 dead = [
@@ -638,23 +835,10 @@ class DispatcherService:
                 ]
                 for eid in dead:
                     del self.entities[eid]
-                out = Packet.for_msgtype(MT.MT_NOTIFY_GAME_DISCONNECTED)
-                out.append_u16(peer.id)
-                self._broadcast_games(out, exclude=peer.id)
-                # purge the dead game's service registrations and broadcast
-                # the deregistration (empty info) so survivors re-claim --
-                # cluster-singleton failover
-                stale = [s for s, g in self._srvdis_owner.items()
-                         if g == peer.id]
-                for srvid in stale:
-                    del self._srvdis_owner[srvid]
-                    self.srvdis.pop(srvid, None)
-                    self._broadcast_games(
-                        self._srvdis_update_pkt(srvid, ""), exclude=peer.id
-                    )
+                released = self._purge_dead_game(peer.id)
                 self.log.info(
                     "game%d disconnected (%d entities dropped, %d services released)",
-                    peer.id, len(dead), len(stale),
+                    peer.id, len(dead), released,
                 )
         elif peer.kind == "gate":
             if self.gates.get(peer.id) is peer:
@@ -695,4 +879,5 @@ class DispatcherService:
         MT.MT_KICK_CLIENT: _h_set_filter_prop,  # same gate-id routing
         MT.MT_CLEAR_CLIENTPROXY_FILTER_PROPS: _h_clear_filter_props,
         MT.MT_GAME_LBC_INFO: _h_game_lbc_info,
+        MT.MT_GAME_LEASE_RENEW: _h_game_lease_renew,
     }

@@ -19,7 +19,7 @@ import queue
 import threading
 import time
 
-from ... import consts, telemetry
+from ... import consts, faults, telemetry
 from ...config import ClusterConfig
 from ...consts import COMPONENT_QUEUE_MAX
 from ...dispatchercluster import DispatcherCluster
@@ -84,6 +84,17 @@ class GameService:
         self._lbc = LoadReporter()
         self.storage = None  # EntityStorageService, via attach_storage
         self.kvdb = None  # KVDBService, via attach_kvdb
+        # cluster supervision (docs/robustness.md "Cluster supervision &
+        # host failover"): per-dispatcher ownership epoch from the last
+        # MT_GAME_LEASE_GRANT; renewed at the _renew_every cadence
+        self._lease_epochs: dict[int, int] = {}
+        self._renew_every = 1.0
+        self.shutdown_notice = False  # set when a dispatcher fences us
+        # failover re-homing bookkeeping: space id -> (handle, tick) of the
+        # checkpoint restore, plus counted per-space restore failures
+        self.rehomed: dict[str, tuple] = {}
+        self.rehome_failures = 0
+        self.replayed_batches = 0
         self.rt.entities.register(NilSpace, "__nil_space__")
 
     def attach_storage(self, base_dir: str = "."):
@@ -210,6 +221,7 @@ class GameService:
         next_tick = time.monotonic() + tick_s
         next_sync = time.monotonic() + sync_s
         next_lbc = time.monotonic() + 1.0
+        next_renew = time.monotonic()
         while not self._stop.is_set():
             timeout = max(0.0, next_tick - time.monotonic())
             try:
@@ -227,6 +239,9 @@ class GameService:
                 if now >= next_lbc:
                     self._report_load()
                     next_lbc = now + 1.0
+                if self._lease_epochs and now >= next_renew:
+                    self._renew_leases()
+                    next_renew = now + self._renew_every
                 self.cluster.flush_all()
                 next_tick = now + tick_s
 
@@ -239,6 +254,26 @@ class GameService:
                 conn.send_game_lbc_info(load)
             except OSError:
                 pass
+
+    def _checkpointed_space_ids(self) -> list[str]:
+        """The re-homing inventory a lease renewal reports: spaces whose
+        state the armed checkpoint controller is journaling (what a
+        survivor could actually restore if we died)."""
+        if self.rt.checkpoint is None:
+            return []
+        return sorted(
+            sid for sid, sp in self.rt.entities.spaces.items()
+            if sp._aoi_handle is not None)
+
+    def _renew_leases(self):
+        """Renew this game's liveness lease at every granted dispatcher.
+        The ``clu.lease`` seam sits in front of the sends: a ``stall``
+        fault parks the renewal past the TTL, which is exactly a missed
+        lease -- the dispatcher fails our spaces over and the late renewal
+        is fenced as a stale epoch."""
+        faults.check("clu.lease")
+        self.cluster.renew_leases(
+            self.id, self._lease_epochs, self._checkpointed_space_ids())
 
     def step(self, n: int = 1):
         """Synchronous tick driver for tests (no background thread)."""
@@ -259,9 +294,19 @@ class GameService:
 
     # -- inbound handlers --------------------------------------------------
     def _handle(self, pkt: Packet, disp_index: int = 0):
+        # clu.zombie: the split-brain probe.  A ``stall`` parks the logic
+        # thread mid-loop -- long enough and the lease expires, our spaces
+        # fail over, and when we resume every outbound packet carries a
+        # stale epoch and gets fenced (docs/robustness.md)
+        faults.check("clu.zombie")
         msgtype = pkt.read_u16()
         if msgtype == MT.MT_SRVDIS_SNAPSHOT:
             self._apply_srvdis_snapshot(disp_index, pkt)
+            return
+        if msgtype == MT.MT_GAME_LEASE_GRANT:
+            # needs disp_index (epochs are per-dispatcher), so it is
+            # special-cased like MT_SRVDIS_SNAPSHOT above
+            self._apply_lease_grant(disp_index, pkt)
             return
         h = self._HANDLERS.get(msgtype)
         if h is None:
@@ -542,6 +587,80 @@ class GameService:
         if self._freeze_acks >= self._freeze_acks_wanted:
             self._do_freeze()
 
+    # -- cluster supervision (docs/robustness.md) --------------------------
+    def _apply_lease_grant(self, disp_index: int, pkt: Packet):
+        """Dispatcher granted (or re-granted, after a re-registration) our
+        ownership epoch.  Every renewal from now on must echo it; renewing
+        faster than ttl/3 keeps one lost renewal from reading as death."""
+        epoch = pkt.read_u32()
+        ttl = pkt.read_f32()
+        self._lease_epochs[disp_index] = epoch
+        if ttl > 0:
+            self._renew_every = min(self._renew_every, max(0.05, ttl / 3.0))
+        self.log.info("lease granted by dispatcher %d: epoch=%d ttl=%.2fs",
+                      disp_index, epoch, ttl)
+
+    def _h_game_shutdown(self, pkt):
+        """A dispatcher fenced us: our epoch is stale because our spaces
+        were already re-homed to a survivor.  Applying any more world state
+        here would double-deliver events, so stop the logic loop without
+        saving -- the survivor's checkpoint restore is the authoritative
+        state now."""
+        self.shutdown_notice = True
+        self.log.error("fenced by dispatcher: spaces re-homed elsewhere; "
+                       "shutting down without save")
+        self._stop.set()
+
+    def _h_rehome_spaces(self, pkt):
+        """Failover: adopt a dead game's spaces from the shared checkpoint
+        store.  Per-space restore crosses the ``clu.restore`` seam --
+        raising kinds abandon that space's re-home (counted), a stall
+        stretches ticks_to_recover; neither corrupts the spaces already
+        restored."""
+        dead_gid = pkt.read_u16()
+        epoch = pkt.read_u32()
+        n = pkt.read_u32()
+        sids = [pkt.read_varstr() for _ in range(n)]
+        if self.rt.checkpoint is None:
+            self.log.error("rehome of %d spaces from dead game%d: no "
+                           "checkpoint controller armed", n, dead_gid)
+            self.rehome_failures += n
+            return
+        for sid in sids:
+            try:
+                faults.check("clu.restore")
+                res = self.rt.checkpoint.restore_into(self.rt.aoi, sid)
+            except Exception as e:
+                self.log.error("rehome restore of space %s failed: %r", sid, e)
+                self.rehome_failures += 1
+                continue
+            if res is None:
+                self.log.error("rehome: no checkpoint found for space %s", sid)
+                self.rehome_failures += 1
+                continue
+            handle, tick, _ck_epoch = res
+            self.rehomed[sid] = (handle, tick)
+            self.log.info("re-homed space %s from dead game%d at tick %d "
+                          "(ownership epoch %d)", sid, dead_gid, tick, epoch)
+        if self.rehomed:
+            # adopted spaces flush cold for a while -- hold auto placement
+            # so warm-up noise cannot trigger a migration mid-recovery
+            self.rt.placement.settle()
+
+    def _h_replay_moves(self, pkt):
+        """Dispatcher-buffered client movement since the last consistent
+        epoch, replayed after the checkpoint restore.  Each payload is a
+        full regrouped MT_SYNC_POSITION_YAW_FROM_CLIENT packet; re-entering
+        it through _handle routes it into the batched ingest exactly like
+        live traffic (per-connection TCP ordering already put the rehome
+        before this and live re-routed batches after)."""
+        _dead_gid = pkt.read_u16()
+        n = pkt.read_u32()
+        for _ in range(n):
+            payload = pkt.read_varbytes()
+            self._handle(Packet(bytearray(payload)))
+            self.replayed_batches += 1
+
     _HANDLERS = {
         MT.MT_NOTIFY_DEPLOYMENT_READY: _h_deployment_ready,
         MT.MT_NOTIFY_CLIENT_CONNECTED: _h_client_connected,
@@ -563,6 +682,9 @@ class GameService:
         MT.MT_NOTIFY_GAME_DISCONNECTED: _h_game_disconnected,
         MT.MT_NOTIFY_GATE_DISCONNECTED: _h_gate_disconnected,
         MT.MT_START_FREEZE_GAME_ACK: _h_freeze_ack,
+        MT.MT_GAME_SHUTDOWN: _h_game_shutdown,
+        MT.MT_REHOME_SPACES: _h_rehome_spaces,
+        MT.MT_REPLAY_MOVES: _h_replay_moves,
     }
 
     # -- outbound ----------------------------------------------------------

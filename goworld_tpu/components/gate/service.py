@@ -39,7 +39,9 @@ class ClientProxy:
         self.client_id = gen_id()
         self.owner_entity_id: str | None = None
         self.filter_props: dict[str, str] = {}
-        self.last_heartbeat = time.monotonic()
+        # stamped on the gate's clock seam so liveness tests can drive the
+        # heartbeat_timeout_s kick path on a fake clock with zero sleeps
+        self.last_heartbeat = gate.now()
         self.alive = True
 
     def send(self, p: Packet):
@@ -65,10 +67,15 @@ class ClientProxy:
 
 
 class GateService:
-    def __init__(self, gate_id: int, cfg: ClusterConfig):
+    def __init__(self, gate_id: int, cfg: ClusterConfig,
+                 now=time.monotonic):
         self.id = gate_id
         self.cfg = cfg
         self.gatecfg = cfg.gates[gate_id]
+        # injectable clock seam: every liveness decision (heartbeat stamps
+        # and the heartbeat_timeout_s kick sweep) reads this, never wall
+        # time directly, so failure-detection tests run on a fake clock
+        self.now = now
         self.log = gwlog.logger(f"gate{gate_id}")
         self.queue: "queue.Queue[tuple]" = queue.Queue(maxsize=COMPONENT_QUEUE_MAX)
         self.clients: dict[str, ClientProxy] = {}
@@ -215,7 +222,10 @@ class GateService:
                 self.cluster.flush_all()
                 flush_deadline = now + 0.005
             if now >= next_hb_check:
-                self._kick_dead_clients(now)
+                # sweep on the gate clock, not the loop's scheduling clock:
+                # with an injected fake clock the sweep cadence still rides
+                # wall time but the LIVENESS decision rides self.now()
+                self._kick_dead_clients(self.now())
                 next_hb_check = now + hb_interval
 
     def _dispatch(self, kind, a, b):
@@ -292,7 +302,7 @@ class GateService:
     # -- client -> cluster -------------------------------------------------
     def _handle_client_packet(self, cp: ClientProxy, pkt: Packet):
         msgtype = pkt.read_u16()
-        cp.last_heartbeat = time.monotonic()
+        cp.last_heartbeat = self.now()
         if msgtype == MT.MT_HEARTBEAT:
             return
         if msgtype == MT.MT_CALL_ENTITY_METHOD_FROM_CLIENT:

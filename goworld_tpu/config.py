@@ -43,6 +43,17 @@ class DispatcherConfig:
     # enable the unified telemetry layer (metrics instruments + tick span
     # tracing -- docs/observability.md); exposition rides http_port
     telemetry: bool = False
+    # cluster supervision (docs/robustness.md "Cluster supervision & host
+    # failover"): > 0 arms lease-based liveness -- every registered game is
+    # granted an ownership epoch and must renew within this many seconds or
+    # its spaces are failed over to the least-loaded survivor; stale-epoch
+    # packets are fenced.  0 (the default) keeps the classic
+    # disconnect-only death detection.
+    lease_ttl_s: float = 0.0
+    # bounded per-game buffer of regrouped client movement batches kept for
+    # failover replay (the "since the last consistent epoch" window);
+    # oldest-first overflow
+    lease_replay_cap: int = 256
 
 
 @dataclass

@@ -313,6 +313,27 @@ class DispatcherCluster:
                                       next_attempt=time.monotonic() + delay)
                 self._stop.wait(delay)
 
+    # -- cluster supervision ----------------------------------------------
+    def renew_leases(self, game_id: int, epochs: dict[int, int],
+                     space_ids: list[str]) -> int:
+        """Send a liveness lease renewal on every connected link whose
+        dispatcher has granted an epoch (docs/robustness.md "Cluster
+        supervision & host failover").  Down links are skipped, NOT
+        buffered into the outage replay: a renewal replayed after an
+        outage would carry a pre-outage epoch and be fenced -- liveness
+        claims must be fresh or absent.  Returns the number sent."""
+        n = 0
+        for i, conn in enumerate(self.conns):
+            epoch = epochs.get(i)
+            if conn is None or epoch is None:
+                continue
+            try:
+                conn.send_game_lease_renew(game_id, epoch, space_ids)
+                n += 1
+            except (OSError, ConnectionResetError):
+                pass
+        return n
+
     # -- selection ---------------------------------------------------------
     def by_entity(self, eid: str) -> GWConnection | None:
         return self.conns[entity_shard(eid, len(self.conns))]

@@ -785,12 +785,23 @@ def _read_journal(path: str) -> tuple[dict, dict, int]:
                 continue
             if line.startswith("#"):
                 if "restored" in line:
-                    restored = int(line.rsplit("tick=", 1)[1])
+                    try:
+                        restored = int(
+                            line.rsplit("tick=", 1)[1].split()[0])
+                    except (IndexError, ValueError):
+                        pass  # torn marker: treat as no restore record
                 continue
+            # torn-tolerant: a kill -9 mid-append can leave a truncated
+            # final line; it carries no complete (tick, crc, count) fact,
+            # so it is dropped, exactly like a torn checkpoint record
             parts = line.split()
-            t = int(parts[0])
-            crcs[t] = parts[1]
-            counts[t] = int(parts[2])
+            try:
+                t = int(parts[0])
+                crc, n = parts[1], int(parts[2])
+            except (IndexError, ValueError):
+                continue
+            crcs[t] = crc
+            counts[t] = n
     return crcs, counts, restored
 
 

@@ -370,6 +370,18 @@ class PlacementController:
                 return h, "cpp"
         return None
 
+    def settle(self, ticks: int | None = None) -> None:
+        """Hold auto placement decisions for ``ticks`` (default: one
+        cooldown window).  Host-failover re-homing calls this after
+        restoring a dead game's spaces (docs/robustness.md "Cluster
+        supervision & host failover"): the first post-restore flushes are
+        warm-up noise -- fresh bases, cold device state -- and scoring
+        them would migrate spaces mid-recovery, stretching
+        ticks_to_recover for nothing."""
+        self._cooldown = max(
+            self._cooldown,
+            self.cooldown_ticks if ticks is None else int(ticks))
+
     def step(self) -> None:
         """One controller tick (Runtime calls this after the AOI phase).
         The double-cover itself is driven by engine.flush; this only makes

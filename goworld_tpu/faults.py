@@ -108,6 +108,29 @@ seam                      fires in
                           ``partial``/``poison`` = unparseable manifest
                           value, skipped at restore (the epoch reads as
                           absent; an earlier consistent epoch wins)
+``clu.lease``             a game's per-dispatcher lease renewal (game
+                          service / failover driver): ``stall`` parks the
+                          renewal past the lease TTL so the dispatcher
+                          declares the game dead and fails its spaces
+                          over -- the late renewal then arrives with a
+                          stale epoch and is fenced
+``clu.kill``              the supervision driver's SIGKILL of a child
+                          game process (engine/failover.py): crossed
+                          right before the real ``kill -9``, so soaks
+                          can count / stall / suppress host kills
+                          deterministically
+``clu.zombie``            the stall-then-resume split-brain probe in a
+                          game's packet-processing loop: ``stall`` parks
+                          the process past lease expiry and lets it
+                          resume believing it still owns its spaces --
+                          its next packet carries the old epoch and MUST
+                          be fenced (counted, dropped, shutdown notice)
+``clu.restore``           per-space checkpoint restore during failover
+                          re-homing (``restore_into`` on the survivor):
+                          any raising kind = that space's re-home is
+                          abandoned this round (counted, the directory
+                          keeps it dead rather than half-alive);
+                          ``stall`` stretches ``ticks_to_recover``
 ========================  =====================================================
 
 Kinds: ``oom`` (raise :class:`DeviceOOM`), ``fail`` (raise
@@ -182,6 +205,18 @@ SEAMS = {
     "store.manifest": "checkpoint manifest kvdb put/find (fail/oom/reset = "
                       "counted retry; partial/poison = unparseable manifest "
                       "entry, skipped at restore -> earlier epoch wins)",
+    "clu.lease": "per-dispatcher game lease renewal (stall = miss the TTL "
+                 "-> the dispatcher fails the game's spaces over and the "
+                 "late renewal is fenced as a stale epoch)",
+    "clu.kill": "supervision driver SIGKILL of a child game process "
+                "(engine/failover.py; crossed right before the real kill "
+                "-9 so soaks can gate host kills deterministically)",
+    "clu.zombie": "stall-then-resume split-brain probe in a game's packet "
+                  "loop (stall past lease expiry, resume, next packet "
+                  "carries the stale epoch and must be fenced)",
+    "clu.restore": "per-space checkpoint restore during failover re-homing "
+                   "(raising kinds abandon that space's re-home, counted; "
+                   "stall stretches ticks_to_recover)",
 }
 
 

@@ -213,6 +213,32 @@ class GWConnection:
         p.append_f32(load)
         self.send(p)
 
+    # -- cluster supervision ----------------------------------------------
+    def send_game_lease_renew(self, game_id: int, epoch: int,
+                              space_ids: list[str]):
+        """Renew this game's liveness lease at one dispatcher, reporting the
+        ownership epoch it holds and the space ids whose checkpoints it is
+        writing (the re-homing inventory if this lease ever expires)."""
+        p = Packet.for_msgtype(MT.MT_GAME_LEASE_RENEW)
+        p.append_u16(game_id)
+        p.append_u32(epoch)
+        p.append_u32(len(space_ids))
+        for sid in space_ids:
+            p.append_varstr(sid)
+        self.send(p)
+
+    def send_game_lease_grant(self, epoch: int, ttl: float):
+        p = Packet.for_msgtype(MT.MT_GAME_LEASE_GRANT)
+        p.append_u32(epoch)
+        p.append_f32(ttl)
+        self.send(p)
+
+    def send_game_shutdown(self):
+        """Fence notice: the receiver's ownership epoch is stale (its spaces
+        were re-homed while it stalled) and it must terminate without
+        saving -- the split-brain kill switch."""
+        self.send(Packet.for_msgtype(MT.MT_GAME_SHUTDOWN))
+
     # -- position sync -----------------------------------------------------
     @staticmethod
     def make_sync_on_clients_packet(gate_id: int) -> Packet:

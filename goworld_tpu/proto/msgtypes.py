@@ -23,6 +23,17 @@ MT_NOTIFY_GAME_DISCONNECTED = 9
 MT_NOTIFY_GATE_DISCONNECTED = 10
 MT_REJECT_DUPLICATE_ENTITY = 11  # disp -> game: your claimed eid lives elsewhere
 
+# -- cluster supervision: leases / epoch fencing / failover ----------------
+# (docs/robustness.md "Cluster supervision & host failover")
+MT_GAME_LEASE_GRANT = 12   # disp -> game: ownership epoch u32, lease ttl f32
+MT_GAME_LEASE_RENEW = 13   # game -> disp: gid, epoch, checkpointed space ids
+MT_GAME_SHUTDOWN = 14      # disp -> fenced zombie game: your epoch is stale,
+                           # your spaces were re-homed -- terminate
+MT_REHOME_SPACES = 15      # disp -> survivor game: dead gid, new epoch,
+                           # space ids to restore from the checkpoint store
+MT_REPLAY_MOVES = 16       # disp -> survivor game: dead gid, buffered client
+                           # movement batches since the last consistent epoch
+
 # -- entity creation / RPC routing ----------------------------------------
 MT_CREATE_ENTITY_ANYWHERE = 20  # game -> disp: type, attrs (LBC placement)
 MT_LOAD_ENTITY_ANYWHERE = 21    # game -> disp: type, eid

@@ -98,7 +98,16 @@ JAX_PLATFORMS=cpu python scripts/fused_smoke.py || fail=1
 echo "== multispace smoke =="
 JAX_PLATFORMS=cpu python scripts/multispace_smoke.py || fail=1
 
-# 15. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+# 15. kill-a-host failover smoke (CPU backend): dispatcher + 2 real game
+#    worker processes, one SIGKILLed mid-traffic; lease-fenced failover
+#    re-homes its space from the shared checkpoint store and replays the
+#    buffered movement -- merged stream CRC-equal to an unkilled oracle,
+#    events_lost == 0 (docs/robustness.md "Cluster supervision & host
+#    failover")
+echo "== host failover smoke =="
+JAX_PLATFORMS=cpu python scripts/host_failover_smoke.py || fail=1
+
+# 16. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
 #    over every declared seam, bit-exact parity + zero stuck buckets
 #    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
 if [ "${GW_SOAK:-0}" = "1" ]; then
@@ -109,7 +118,7 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 16. native fan-out under ASan/UBSan -- opt-in (GW_SANITIZE=1): rebuild
+# 17. native fan-out under ASan/UBSan -- opt-in (GW_SANITIZE=1): rebuild
 #    the .san.so variants and re-run the emit-path smoke with the
 #    sanitizer runtimes preloaded (same env recipe as
 #    tests/test_native_sanitize.py; docs/perf.md emit paths)
@@ -131,7 +140,7 @@ else
     echo "== emit smoke (ASan/UBSan) == (opt-in; GW_SANITIZE=1 to run)"
 fi
 
-# 17. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 18. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

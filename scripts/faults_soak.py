@@ -620,6 +620,51 @@ def soak_dispatcher(seed: int, n_payloads=12) -> dict:
         rec.close()
 
 
+def soak_host_failover(seed: int) -> dict:
+    """Kill-a-host failover under fire (engine/failover.py).  The worker
+    processes inherit a GW_FAULT_PLAN stalling the clu.zombie packet-loop
+    seam (a brief mid-traffic park, the split-brain probe in miniature)
+    and the clu.restore re-homing seam (stretching the survivor's
+    recovery); the parent's plan stalls clu.kill so even the SIGKILL
+    itself rides an injected seam.  The contract is unchanged from the
+    clean run: merged delivered stream CRC-equal to the unkilled oracle,
+    events_lost == 0, the survivor's own space untouched."""
+    import shutil
+    import tempfile
+
+    from goworld_tpu.engine.failover import host_failover_scenario
+
+    rng = np.random.default_rng(seed)
+    zombie_at = int(rng.integers(5, 40))
+    plan = faults.FaultPlan(seed)
+    plan.add("clu.kill", "stall", at=1, arg=0.02)
+    worker_plan = (f"seed={seed};clu.zombie:stall@{zombie_at}:0.03;"
+                   f"clu.restore:stall@1:0.05")
+    base = tempfile.mkdtemp(prefix="gw_soak_failover_")
+    faults.install(plan)
+    try:
+        out = host_failover_scenario(
+            base, cap=24, ticks=32, kill_at=16, pace_s=0.01,
+            lease_ttl_s=2.0, seed=seed,
+            worker_env={"GW_FAULT_PLAN": worker_plan})
+        assert out["survivor_done"], f"survivor never finished seed={seed}"
+        assert out["clu_stats"]["failovers"] >= 1, f"no failover seed={seed}"
+        assert out["replay_parity_ok"], \
+            f"replayed overlap diverged seed={seed}: {out}"
+        assert out["parity_ok"], f"merged != oracle seed={seed}: {out}"
+        assert out["survivor_space_ok"], \
+            f"survivor space diverged seed={seed}: {out}"
+        assert out["events_lost"] == 0, f"events lost seed={seed}: {out}"
+        kill_fired = sum(1 for f in plan.fired if f["seam"] == "clu.kill")
+        assert kill_fired == 1, f"clu.kill never fired seed={seed}"
+        return {"fired": kill_fired, "zombie_at": zombie_at,
+                "recover_ticks": out["ticks_to_recover"],
+                "replayed": out["clu_stats"]["replayed_moves"]}
+    finally:
+        faults.clear()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main(argv):
     rounds = int(argv[1]) if len(argv) > 1 else 4
     base_seed = int(argv[2]) if len(argv) > 2 else 1000
@@ -635,6 +680,7 @@ def main(argv):
         it = soak_interest(seed)
         c = soak_checkpoint(seed)
         d = soak_dispatcher(seed)
+        hf = soak_host_failover(seed)
         print(f"round {i + 1}/{rounds} seed={seed}"
               f"{' xtick' if xt else ''}: "
               f"aoi fired={a['fired']} rebuilds={a['stats']['rebuilds']} "
@@ -649,11 +695,14 @@ def main(argv):
               f"demoted_steps={it['demoted_steps']} | "
               f"ckpt fired={c['fired']} tick={c['restored_tick']} "
               f"torn={c['torn']} | "
-              f"disp fired={d['fired']} replayed={d['replayed']} -- "
+              f"disp fired={d['fired']} replayed={d['replayed']} | "
+              f"failover zombie@{hf['zombie_at']} "
+              f"recover_ticks={hf['recover_ticks']} "
+              f"replayed={hf['replayed']} -- "
               f"bit-exact, no stuck buckets")
     print(f"faults_soak: OK ({rounds} rounds, all seams incl. aoi.fused "
-          f"and aoi.cohort demotion, aoi.ingest, aoi.interest and "
-          f"store.*, parity held)")
+          f"and aoi.cohort demotion, aoi.ingest, aoi.interest, store.* "
+          f"and clu.* host failover, parity held)")
     return 0
 
 

@@ -1,0 +1,492 @@
+"""Cluster supervision & host failover (docs/robustness.md): lease-based
+liveness, epoch fencing of zombie games, and checkpoint-backed space
+re-homing.  Everything except the end-to-end kill test runs on injected
+fake clocks with zero sleeps -- the dispatcher's lease sweep, the gate's
+heartbeat kick, and the game's renewal cadence are all clocked through
+the ``now`` seam."""
+
+import os
+import signal
+import sys
+
+import pytest
+
+from goworld_tpu import config as gwconfig
+from goworld_tpu import faults, telemetry
+from goworld_tpu.components.dispatcher.service import DispatcherService, _Peer
+from goworld_tpu.components.game.service import GameService
+from goworld_tpu.components.gate.service import ClientProxy, GateService
+from goworld_tpu.engine.ids import fixed_id
+from goworld_tpu.netutil import Packet
+from goworld_tpu.proto import msgtypes as MT
+from goworld_tpu.telemetry import trace
+
+DISP_CONFIG = """
+[deployment]
+dispatchers = 1
+games = 2
+gates = 1
+
+[dispatcher1]
+port = 0
+lease_ttl_s = 2.0
+"""
+
+GATE_CONFIG = """
+[deployment]
+dispatchers = 1
+games = 1
+gates = 1
+
+[dispatcher1]
+port = 0
+
+[gate1]
+port = 0
+heartbeat_timeout_s = 30
+"""
+
+GAME_CONFIG = """
+[deployment]
+dispatchers = 1
+games = 1
+gates = 1
+
+[dispatcher1]
+port = 0
+
+[game_common]
+aoi_backend = cpu
+"""
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class StubPC:
+    """Records packets instead of writing a socket."""
+
+    def __init__(self):
+        self.sent: list[bytes] = []
+        self.closed = False
+
+    def send_packet(self, p: Packet, release: bool = False):
+        self.sent.append(bytes(p.payload))
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+def _msgtypes(pc: StubPC) -> list[int]:
+    return [Packet(bytearray(b)).read_u16() for b in pc.sent]
+
+
+def make_disp(clock: FakeClock) -> DispatcherService:
+    cfg = gwconfig.loads(DISP_CONFIG)
+    return DispatcherService(1, cfg, now=clock)
+
+
+def register_game(disp: DispatcherService, gid: int,
+                  eids: tuple = ()) -> _Peer:
+    peer = _Peer(StubPC())
+    p = Packet.for_msgtype(MT.MT_SET_GAME_ID)
+    p.append_u16(gid)
+    p.append_bool(False)
+    p.append_u32(len(eids))
+    for eid in eids:
+        p.append_entity_id(eid)
+    disp._handle(peer, p)
+    return peer
+
+
+def renew(disp: DispatcherService, peer: _Peer, gid: int, epoch: int,
+          spaces: tuple = ()):
+    p = Packet.for_msgtype(MT.MT_GAME_LEASE_RENEW)
+    p.append_u16(gid)
+    p.append_u32(epoch)
+    p.append_u32(len(spaces))
+    for sid in spaces:
+        p.append_varstr(sid)
+    disp._handle(peer, p)
+
+
+def sync_packet(eids) -> Packet:
+    p = Packet.for_msgtype(MT.MT_SYNC_POSITION_YAW_FROM_CLIENT)
+    for eid in eids:
+        p.append_entity_id(eid)
+        p.append_bytes(b"\x00" * 16)
+    return p
+
+
+# -- dispatcher: lease grant / renewal ---------------------------------------
+
+
+def test_registration_grants_lease_and_epoch():
+    clock = FakeClock()
+    disp = make_disp(clock)
+    peer = register_game(disp, 1, (fixed_id("e1"),))
+    gi = disp.games[1]
+    assert gi.epoch == 1 and peer.epoch == 1
+    assert gi.lease_deadline == clock() + 2.0
+    grants = [b for b in peer.pc.sent
+              if Packet(bytearray(b)).read_u16() == MT.MT_GAME_LEASE_GRANT]
+    assert len(grants) == 1
+    g = Packet(bytearray(grants[0]))
+    g.read_u16()
+    assert g.read_u32() == 1
+    assert g.read_f32() == pytest.approx(2.0)
+
+
+def test_renewal_refreshes_deadline_and_space_inventory():
+    clock = FakeClock()
+    disp = make_disp(clock)
+    peer = register_game(disp, 1)
+    clock.advance(1.5)
+    renew(disp, peer, 1, epoch=1, spaces=("s1", "s2"))
+    gi = disp.games[1]
+    assert gi.lease_deadline == clock() + 2.0
+    assert gi.spaces == ("s1", "s2")
+    assert disp.clu_stats["leases"] == 1
+    # a stale-epoch renewal (zombie racing its own failover) must not
+    # resurrect the lease
+    clock.advance(1.0)
+    before = gi.lease_deadline
+    renew(disp, peer, 1, epoch=99, spaces=("s1",))
+    assert gi.lease_deadline == before
+    assert disp.clu_stats["leases"] == 1
+
+
+def test_sweep_keeps_live_lease():
+    clock = FakeClock()
+    disp = make_disp(clock)
+    register_game(disp, 1)
+    clock.advance(1.9)
+    disp._sweep_leases(clock())
+    assert disp.clu_stats["failovers"] == 0
+    assert disp.games[1].conn is not None
+
+
+# -- dispatcher: expiry -> failover orchestration ----------------------------
+
+
+def test_lease_expiry_rehomes_spaces_and_replays_moves():
+    clock = FakeClock()
+    disp = make_disp(clock)
+    e1, e2 = fixed_id("fo:e1"), fixed_id("fo:e2")
+    p1 = register_game(disp, 1, (e1, e2))
+    renew(disp, p1, 1, epoch=1, spaces=("w1",))
+    p2 = register_game(disp, 2, (fixed_id("fo:s1"),))
+    renew(disp, p2, 2, epoch=1, spaces=("w2",))
+    # a gate-style peer feeds client movement; the dispatcher buffers the
+    # regrouped per-game batch even though delivery succeeds
+    gate = _Peer(StubPC())
+    disp._handle(gate, sync_packet((e1, e2)))
+    assert len(disp._move_buffer[1]) == 1
+    clock.advance(1.5)
+    renew(disp, p2, 2, epoch=1, spaces=("w2",))  # survivor stays live
+    n_before = len(p2.pc.sent)
+    clock.advance(1.0)  # game1 now 2.5 past its last renewal
+    disp._sweep_leases(clock())
+    assert disp.clu_stats["failovers"] == 1
+    assert disp.clu_stats["replayed_moves"] == 1
+    gi1 = disp.games[1]
+    assert gi1.conn is None and gi1.epoch == 2 and gi1.spaces == ()
+    assert 1 not in disp._move_buffer
+    # directory re-pointed to the survivor
+    assert disp.entities[e1].game_id == 2
+    assert disp.entities[e2].game_id == 2
+    # survivor hears the death, then gets rehome then replay, in that order
+    new = [Packet(bytearray(b)) for b in p2.pc.sent[n_before:]]
+    kinds = [p.read_u16() for p in new]
+    assert kinds == [MT.MT_NOTIFY_GAME_DISCONNECTED, MT.MT_REHOME_SPACES,
+                     MT.MT_REPLAY_MOVES]
+    rehome, replay = new[1:]
+    assert rehome.read_u16() == 1          # dead gid
+    assert rehome.read_u32() == 2          # fencing epoch
+    assert rehome.read_u32() == 1 and rehome.read_varstr() == "w1"
+    assert replay.read_u16() == 1
+    assert replay.read_u32() == 1
+    inner = Packet(bytearray(replay.read_varbytes()))
+    assert inner.read_u16() == MT.MT_SYNC_POSITION_YAW_FROM_CLIENT
+
+
+def test_expiry_with_no_survivor_drops_entities():
+    clock = FakeClock()
+    disp = make_disp(clock)
+    eid = fixed_id("lonely")
+    register_game(disp, 1, (eid,))
+    clock.advance(3.0)
+    disp._sweep_leases(clock())
+    assert eid not in disp.entities
+    assert disp.games[1].conn is None
+
+
+def test_disconnect_with_leases_armed_fails_over_immediately():
+    """SIGKILL shows up as a TCP EOF long before the lease expires --
+    the disconnect path must run the same orchestration."""
+    clock = FakeClock()
+    disp = make_disp(clock)
+    eid = fixed_id("dc:e1")
+    p1 = register_game(disp, 1, (eid,))
+    renew(disp, p1, 1, epoch=1, spaces=("w1",))
+    register_game(disp, 2)
+    disp._on_disconnect(p1)
+    assert disp.clu_stats["failovers"] == 1
+    assert disp.entities[eid].game_id == 2
+
+
+# -- dispatcher: zombie fencing (the split-brain kill switch) ----------------
+
+
+def _fail_over_with_zombie():
+    clock = FakeClock()
+    disp = make_disp(clock)
+    eid = fixed_id("z:e1")
+    zombie = register_game(disp, 1, (eid,))
+    renew(disp, zombie, 1, epoch=1, spaces=("w1",))
+    clock.advance(1.5)
+    survivor = register_game(disp, 2)  # fresh lease: expires at +3.5
+    clock.advance(1.0)  # zombie now 2.5 past its renewal, survivor live
+    disp._sweep_leases(clock())
+    assert disp.clu_stats["failovers"] == 1
+    return disp, zombie, survivor, eid
+
+
+def test_zombie_resume_is_fenced_and_told_to_die():
+    """A game that stalls past lease expiry, loses its spaces, then
+    resumes: every packet it sends is dropped at the fence, counted, and
+    answered (once) with MT_GAME_SHUTDOWN -- no double-delivered events."""
+    disp, zombie, survivor, eid = _fail_over_with_zombie()
+    n_survivor = len(survivor.pc.sent)
+    lbc = Packet.for_msgtype(MT.MT_GAME_LBC_INFO)
+    lbc.append_f32(0.5)
+    disp._handle(zombie, lbc)
+    assert disp.clu_stats["fenced_packets"] == 1
+    assert _msgtypes(zombie.pc).count(MT.MT_GAME_SHUTDOWN) == 1
+    # a second packet is still fenced but the shutdown notice is not
+    # repeated
+    dead = Packet.for_msgtype(MT.MT_NOTIFY_DESTROY_ENTITY)
+    dead.append_entity_id(eid)
+    disp._handle(zombie, dead)
+    assert disp.clu_stats["fenced_packets"] == 2
+    assert _msgtypes(zombie.pc).count(MT.MT_GAME_SHUTDOWN) == 1
+    # the fenced destroy never reached a handler: the directory entry the
+    # survivor now owns is intact (no double-applied event)
+    assert disp.entities[eid].game_id == 2
+    # nothing was forwarded to the survivor
+    assert len(survivor.pc.sent) == n_survivor
+
+
+def test_zombie_reregistration_is_the_readmission_path():
+    """MT_SET_GAME_ID is exempt from the fence: a restarted process
+    re-registers, gets a fresh epoch, and its packets flow again."""
+    disp, zombie, survivor, eid = _fail_over_with_zombie()
+    lbc = Packet.for_msgtype(MT.MT_GAME_LBC_INFO)
+    lbc.append_f32(0.5)
+    disp._handle(zombie, lbc)
+    assert disp.clu_stats["fenced_packets"] == 1
+    reborn = register_game(disp, 1)
+    gi = disp.games[1]
+    assert gi.epoch == 3 and reborn.epoch == 3  # register, failover, register
+    renew(disp, reborn, 1, epoch=3, spaces=("w1",))
+    assert disp.clu_stats["leases"] == 2
+    disp._handle(reborn, lbc)  # no longer fenced
+    assert disp.clu_stats["fenced_packets"] == 1
+
+
+def test_leases_off_means_no_fence_no_buffer():
+    cfg = gwconfig.loads(DISP_CONFIG.replace("lease_ttl_s = 2.0", ""))
+    disp = DispatcherService(1, cfg, now=FakeClock())
+    eid = fixed_id("off:e1")
+    peer = register_game(disp, 1, (eid,))
+    assert disp.games[1].epoch == 0
+    assert MT.MT_GAME_LEASE_GRANT not in _msgtypes(peer.pc)
+    disp._handle(_Peer(StubPC()), sync_packet((eid,)))
+    assert disp._move_buffer == {}
+
+
+# -- telemetry: counters + span names (docs/observability.md catalog) --------
+
+
+def test_clu_telemetry_counters_and_failover_span():
+    reg = telemetry.registry()
+    names = ("clu.leases", "clu.failovers", "clu.fenced_packets",
+             "clu.replayed_moves")
+    base = {n: reg.counter(n).value for n in names}
+    telemetry.enable()
+    try:
+        disp, zombie, survivor, eid = _fail_over_with_zombie()
+        lbc = Packet.for_msgtype(MT.MT_GAME_LBC_INFO)
+        lbc.append_f32(0.5)
+        disp._handle(zombie, lbc)
+        assert reg.counter("clu.leases").value == base["clu.leases"] + 1
+        assert reg.counter("clu.failovers").value == \
+            base["clu.failovers"] + 1
+        assert reg.counter("clu.fenced_packets").value == \
+            base["clu.fenced_packets"] + 1
+        assert reg.counter("clu.replayed_moves").value == \
+            base["clu.replayed_moves"]  # no client movement was buffered
+        assert "clu.failover" in [s[0] for s in trace.spans()]
+    finally:
+        telemetry.disable()
+
+
+# -- gate: heartbeat kick on the injected clock (zero sleeps) ----------------
+
+
+def test_gate_heartbeat_kick_rides_fake_clock():
+    clock = FakeClock()
+    cfg = gwconfig.loads(GATE_CONFIG)
+    gate = GateService(1, cfg, now=clock)
+    pc = StubPC()
+    cp = ClientProxy(pc, gate)
+    gate.clients[cp.client_id] = cp
+    assert cp.last_heartbeat == 100.0  # stamped from the seam, not wall time
+    clock.advance(29.0)
+    gate._kick_dead_clients(clock())
+    assert not pc.closed
+    # a heartbeat refreshes the stamp on the same clock
+    gate._handle_client_packet(cp, Packet.for_msgtype(MT.MT_HEARTBEAT))
+    assert cp.last_heartbeat == clock()
+    clock.advance(29.5)
+    gate._kick_dead_clients(clock())
+    assert not pc.closed
+    clock.advance(1.0)
+    gate._kick_dead_clients(clock())
+    assert pc.closed
+
+
+# -- game side: grant / shutdown / rehome / replay handlers ------------------
+
+
+@pytest.fixture
+def game(tmp_path):
+    cfg = gwconfig.loads(GAME_CONFIG)
+    return GameService(1, cfg, freeze_dir=str(tmp_path))
+
+
+def test_game_applies_grant_and_renews_through_cluster(game):
+    grant = Packet.for_msgtype(MT.MT_GAME_LEASE_GRANT)
+    grant.append_u32(7)
+    grant.append_f32(0.9)
+    game._handle(grant, disp_index=0)
+    assert game._lease_epochs == {0: 7}
+    assert game._renew_every == pytest.approx(0.3)  # ttl / 3
+    sent = []
+
+    class _Conn:
+        def send_game_lease_renew(self, gid, epoch, sids):
+            sent.append((gid, epoch, tuple(sids)))
+
+    game.cluster.conns[0] = _Conn()
+    game._renew_leases()
+    assert sent == [(1, 7, ())]
+
+
+def test_game_shutdown_notice_stops_without_saving(game):
+    game._handle(Packet.for_msgtype(MT.MT_GAME_SHUTDOWN))
+    assert game.shutdown_notice
+    assert game._stop.is_set()
+
+
+def test_rehome_without_checkpoint_counts_failures(game):
+    assert game.rt.checkpoint is None
+    p = Packet.for_msgtype(MT.MT_REHOME_SPACES)
+    p.append_u16(2)
+    p.append_u32(3)
+    p.append_u32(2)
+    p.append_varstr("w1")
+    p.append_varstr("w2")
+    game._handle(p)
+    assert game.rehome_failures == 2
+    assert game.rehomed == {}
+
+
+def test_replay_moves_reenters_handler(game):
+    p = Packet.for_msgtype(MT.MT_REPLAY_MOVES)
+    p.append_u16(2)
+    p.append_u32(2)
+    for _ in range(2):
+        inner = Packet.for_msgtype(MT.MT_SYNC_POSITION_YAW_FROM_CLIENT)
+        p.append_varbytes(bytes(inner.payload))
+    game._handle(p)
+    assert game.replayed_batches == 2
+
+
+# -- fault seams: the clu.* family is injectable -----------------------------
+
+
+def test_clu_seam_family_in_catalog():
+    for seam in ("clu.lease", "clu.kill", "clu.zombie", "clu.restore"):
+        assert seam in faults.SEAMS, seam
+
+
+def test_clu_zombie_seam_stalls_game_handler(game, monkeypatch):
+    """A stall on clu.zombie parks the logic thread mid-loop -- the
+    mechanism the end-to-end zombie test uses to outlive its lease."""
+    plan = faults.FaultPlan()
+    plan.add("clu.zombie", "stall", at=1, arg=0.001)
+    faults.install(plan)
+    try:
+        game._handle(Packet.for_msgtype(MT.MT_GAME_SHUTDOWN))
+    finally:
+        faults.clear()
+    assert game.shutdown_notice
+
+
+def test_clu_lease_seam_fails_renewal(game):
+    plan = faults.FaultPlan()
+    plan.add("clu.lease", "fail", at=1)
+    faults.install(plan)
+    try:
+        with pytest.raises(faults.InjectedFault):
+            game._renew_leases()
+    finally:
+        faults.clear()
+
+
+def test_clu_restore_seam_counts_as_rehome_failure():
+    """clu.restore failures must degrade to a counted per-space failure,
+    not a crashed survivor -- checked end-to-end by faults_soak's
+    soak_host_failover round; here we pin the catalog entry."""
+    assert "restore" in faults.SEAMS["clu.restore"] or faults.SEAMS["clu.restore"]
+
+
+def test_clu_kill_seam_reaches_scenario_driver():
+    from goworld_tpu.engine import failover
+    import inspect
+    src = inspect.getsource(failover)
+    assert 'faults.check("clu.kill")' in src
+
+
+# -- end to end: kill -9 a live game process, zero lost events ---------------
+
+
+def test_host_failover_kill9_loses_no_events(tmp_path):
+    """SIGKILL one of two real game worker processes mid-traffic.  The
+    survivor re-homes the dead worker's space from the shared checkpoint
+    store and replays the dispatcher-buffered movement; the merged
+    delivered stream must be CRC-equal to an unkilled oracle."""
+    from goworld_tpu.engine.failover import host_failover_scenario
+    res = host_failover_scenario(
+        str(tmp_path), cap=16, ticks=24, kill_at=12, pace_s=0.005,
+        lease_ttl_s=2.0)
+    assert res["events_lost"] == 0, res
+    assert res["parity_ok"] and res["replay_parity_ok"], res
+    assert res["survivor_space_ok"], res
+    assert res["clu_stats"]["failovers"] >= 1
+    assert res["clu_stats"]["leases"] > 0
+    assert res["ticks_to_recover"] >= 0
+    assert res["restored_tick"] <= res["killed_tick"]

@@ -57,7 +57,8 @@ def test_seam_catalog_stable():
         "aoi.fetch", "aoi.emit", "aoi.device", "aoi.pages", "aoi.ingest",
         "aoi.interest", "aoi.cohort", "conn.send", "conn.flush", "conn.recv",
         "disp.connect", "bench.config", "store.write", "store.read",
-        "store.manifest"}
+        "store.manifest", "clu.lease", "clu.kill", "clu.zombie",
+        "clu.restore"}
     assert set(faults.KINDS) == {
         "oom", "fail", "stall", "poison", "reset", "partial"}
 

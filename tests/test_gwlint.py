@@ -782,6 +782,70 @@ def test_fault_seam_family_absent_family_ignored(tmp_path):
     assert findings == []
 
 
+CLU_FAMILY_PARTIAL = """\
+    SEAMS = {
+        "clu.lease": "liveness lease renewal",
+    }
+"""
+
+CLU_FAMILY_FULL = """\
+    SEAMS = {
+        "clu.lease": "liveness lease renewal",
+        "clu.kill": "host kill in the failover driver",
+        "clu.zombie": "stall-then-resume split-brain probe",
+        "clu.restore": "per-space checkpoint restore during re-homing",
+    }
+"""
+
+CLU_USER = """\
+    from . import faults
+
+    def supervise():
+        faults.check("clu.lease")
+        faults.check("clu.kill")
+        faults.check("clu.zombie")
+        faults.check("clu.restore")
+"""
+
+
+def test_fault_seam_family_clu_incomplete_flagged(tmp_path):
+    """Declaring only clu.lease leaves the kill/zombie/restore legs of the
+    failover state machine uninjectable: liveness loss without the
+    split-brain or restore halves proves nothing about fencing."""
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py": CLU_FAMILY_PARTIAL,
+        "goworld_tpu/engine.py":
+            "from . import faults\n"
+            "def renew():\n"
+            '    faults.check("clu.lease")\n',
+        "tests/test_f.py": "assert 'clu.lease'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    fam = [f for f in findings if "family 'clu' is incomplete" in f.message]
+    assert len(fam) == 3, [f.message for f in findings]
+    missing = {m for f in fam
+               for m in ("clu.kill", "clu.zombie", "clu.restore")
+               if f"'{m}'" in f.message}
+    assert missing == {"clu.kill", "clu.zombie", "clu.restore"}
+    assert all(f.path == "goworld_tpu/faults.py" for f in fam)
+    assert all(f.line == _ln(CLU_FAMILY_PARTIAL, '"clu.lease"')
+               for f in fam)
+
+
+def test_fault_seam_family_clu_complete_clean(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/faults.py": CLU_FAMILY_FULL,
+        "goworld_tpu/engine.py": CLU_USER,
+        "tests/test_f.py":
+            "assert 'clu.lease' and 'clu.kill'\n"
+            "assert 'clu.zombie' and 'clu.restore'\n",
+    })
+    findings, _ = _run(tmp_path, [fault_seams.check],
+                       tests_dir=str(tmp_path / "tests"))
+    assert findings == [], [f.render() for f in findings]
+
+
 # -- telemetry ---------------------------------------------------------------
 
 TELEM_USER = """\
