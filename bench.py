@@ -2595,9 +2595,14 @@ def main():
     # first re-run attempt hung here).  Skip them with a note so a
     # no-accelerator `python bench.py` still lands a clean rc-0 artifact
     # from the host-path configs.
-    import jax
+    import jax  # noqa: F401 -- probed through telemetry.accelerator_absent
 
-    on_tpu = jax.default_backend() == "tpu"
+    from goworld_tpu import telemetry
+
+    # one source of truth for the flag: the same probe backs the always-on
+    # accelerator_absent gauge on /debug/metrics, so a scrape and a bench
+    # record can never disagree about the environment
+    on_tpu = not telemetry.accelerator_absent()
 
     def emit(out):
         # every record from a chip-less run carries the flag, so a CPU

@@ -22,6 +22,18 @@ test.  Three ways it rots, mirrored from fault-seam-coverage:
 Names are AST-extracted string first-arguments; "documented" is a
 word-boundary match over docs/observability.md, "tested" the same over
 tests/*.py (ctx.tests_reference).
+
+Wire-propagated telemetry headers get one extra discipline.  A struct
+layout assigned to a ``*_WIRE`` name (``TRACE_WIRE =
+struct.Struct(...)``) rides inside cross-process packets, so two
+component builds can disagree about it mid-rolling-restart.  The rule
+therefore enforces (docs/protocol.md "Trace-context trailer"):
+
+* every ``*_WIRE`` layout declares a sibling ``<NAME>_VERSION``
+  constant -- the version byte is part of the contract, not garnish;
+* every scope that ``.unpack``\\ s a ``*_WIRE`` layout also compares a
+  version somewhere -- unknown versions must be skipped structurally
+  (strip-and-ignore), never interpreted field-by-field.
 """
 
 from __future__ import annotations
@@ -65,6 +77,85 @@ def _telemetry_name(node: ast.Call) -> str | None:
     return None
 
 
+def _symbol(node: ast.AST) -> str | None:
+    """Terminal identifier of a Name or Attribute (``TRACE_WIRE`` out of
+    both ``TRACE_WIRE`` and ``tracectx.TRACE_WIRE``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def _scope_nodes(scope: ast.AST):
+    """Walk a function (or module) body without descending into nested
+    function scopes -- each scope answers for its own version check."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _compares_version(scope: ast.AST) -> bool:
+    """True when the scope contains a comparison whose operands touch a
+    version symbol (``ver``, ``version``, ``TRACE_WIRE_VERSION``, ...)."""
+    for node in _scope_nodes(scope):
+        if not isinstance(node, ast.Compare):
+            continue
+        for op in [node.left, *node.comparators]:
+            sym = _symbol(op)
+            if sym and ("version" in sym.lower() or sym.lower() == "ver"):
+                return True
+    return False
+
+
+def _wire_checks(sf):
+    """Versioning discipline for wire-propagated header layouts."""
+    rel = sf.rel
+    consts: set[str] = set()
+    wire_defs: dict[str, ast.Assign] = {}
+    for stmt in sf.tree.body:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        name = stmt.targets[0].id
+        consts.add(name)
+        if name.endswith("_WIRE") and isinstance(stmt.value, ast.Call):
+            func = stmt.value.func
+            if (isinstance(func, ast.Attribute) and func.attr == "Struct") \
+                    or (isinstance(func, ast.Name) and func.id == "Struct"):
+                wire_defs[name] = stmt
+    for name, stmt in sorted(wire_defs.items()):
+        if name + "_VERSION" not in consts:
+            yield Finding(
+                RULE, rel, stmt.lineno, stmt.col_offset,
+                f"wire layout {name!r} has no {name}_VERSION constant: "
+                "wire-propagated header fields must carry a version so "
+                "a receiver can skip layouts it does not understand")
+    scopes = [sf.tree] + [n for n in ast.walk(sf.tree)
+                          if isinstance(n, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))]
+    for scope in scopes:
+        for node in _scope_nodes(scope):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "unpack"):
+                continue
+            sym = _symbol(node.func.value)
+            if sym is None or not sym.endswith("_WIRE"):
+                continue
+            if not _compares_version(scope):
+                yield Finding(
+                    RULE, rel, node.lineno, node.col_offset,
+                    f"{sym}.unpack outside a version comparison: "
+                    "interpret wire header fields only behind a version "
+                    "check (strip-and-ignore unknown versions)")
+            break  # one finding per scope is enough
+
+
 def _doc_text(ctx: Context) -> str:
     path = os.path.join(ctx.root, "docs", "observability.md")
     try:
@@ -87,6 +178,7 @@ def check(ctx: Context):
         rel = sf.rel
         if rel.startswith("tests/") or "/analysis/" in rel:
             continue
+        yield from _wire_checks(sf)
         in_pkg = "/telemetry/" in rel or rel.startswith("telemetry/")
         if in_pkg:
             # purity: module-level jax import stalls every importer; the

@@ -303,6 +303,118 @@ def cmd_build(args) -> int:
     return 0 if ok else 1
 
 
+def _parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
+    """Minimal Prometheus text-exposition parser: (name, labels, value)
+    per sample line; HELP/TYPE comments skipped."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        head, _, sval = line.rpartition(" ")
+        if not head:
+            continue
+        labels: dict[str, str] = {}
+        name = head
+        if head.endswith("}") and "{" in head:
+            name, _, rest = head.partition("{")
+            for part in rest[:-1].split(","):
+                if not part:
+                    continue
+                k, _, v = part.partition("=")
+                labels[k] = v.strip('"')
+        try:
+            out.append((name, labels, float(sval)))
+        except ValueError:
+            pass
+    return out
+
+
+def cmd_gwtop(args) -> int:
+    """Live terminal dashboard over a dispatcher's federated
+    ``/debug/metrics`` (docs/observability.md "Cluster metrics"): one row
+    per component with its headline series, plus any ``--filter`` matches.
+    ``--once`` prints a single frame (tests / piping)."""
+    import urllib.request
+
+    url = args.url.rstrip("/")
+    if not url.startswith("http"):
+        url = "http://" + url
+    if not url.endswith("/debug/metrics"):
+        url += "/debug/metrics"
+
+    def frame() -> str:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            samples = _parse_prometheus(resp.read().decode("utf-8", "replace"))
+        by_comp: dict[str, dict[str, float]] = {}
+        rest: list[tuple[str, dict, float]] = []
+        for name, labels, val in samples:
+            comp = labels.get("component")
+            if comp is not None:
+                key = name
+                extra = {k: v for k, v in labels.items()
+                         if k not in ("component", "series")}
+                if extra:
+                    key += "{" + ",".join(
+                        f"{k}={v}" for k, v in sorted(extra.items())) + "}"
+                by_comp.setdefault(comp, {})[key] = val
+            else:
+                rest.append((name, labels, val))
+        lines = [f"gwtop  {url}  components={len(by_comp)}", ""]
+        headline = ("tick.count", "aoi.entities", "net.packets_sent",
+                    "net.packets_recv", "trace.hops", "flight.dumps",
+                    "clu.failovers", "accelerator_absent")
+        for comp in sorted(by_comp):
+            series = by_comp[comp]
+            cells = []
+            for want in headline:
+                hits = [v for k, v in series.items()
+                        if k == want or k.startswith(want + "{")]
+                if hits:
+                    cells.append(f"{want}={sum(hits):g}")
+            lines.append(f"  {comp:14s} {'  '.join(cells)}")
+            if args.filter:
+                for k in sorted(series):
+                    if args.filter in k:
+                        lines.append(f"    {k:40s} {series[k]:g}")
+        lines.append("")
+        shown = 0
+        for name, labels, val in sorted(rest):
+            if args.filter and args.filter not in name:
+                continue
+            if not args.filter and not (
+                    name.startswith("clu.") or name == "accelerator_absent"):
+                continue
+            lab = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            lines.append(f"  {name + ('{' + lab + '}' if lab else ''):44s} "
+                         f"{val:g}")
+            shown += 1
+            if shown >= args.limit:
+                lines.append(f"  ... ({args.limit}-row cap; use --filter)")
+                break
+        return "\n".join(lines)
+
+    if args.once:
+        try:
+            print(frame())
+        except OSError as e:
+            print(f"gwtop: {url}: {e}", file=sys.stderr)
+            return 1
+        return 0
+    try:
+        while True:
+            try:
+                body = frame()
+            except OSError as e:
+                body = f"gwtop: {url}: {e}"
+            # ANSI home+clear keeps the frame flicker-free in any terminal
+            sys.stdout.write("\x1b[H\x1b[2J" + body + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="goworld_tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -318,6 +430,18 @@ def main(argv=None) -> int:
             if name == "start":
                 p.add_argument("--restore", action="store_true")
         p.set_defaults(fn=fn)
+    p = sub.add_parser("gwtop", help="live cluster metrics dashboard "
+                                     "(scrapes a dispatcher /debug/metrics)")
+    p.add_argument("url", help="dispatcher debug address, e.g. "
+                               "127.0.0.1:8000 (path optional)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--filter", default=None,
+                   help="substring filter for extra series rows")
+    p.add_argument("--limit", type=int, default=40,
+                   help="cap on unlabeled series rows per frame")
+    p.set_defaults(fn=cmd_gwtop)
     p = sub.add_parser("build")
     p.add_argument("--sanitize", action="store_true",
                    help="also build ASAN+UBSAN variants of the native libs "

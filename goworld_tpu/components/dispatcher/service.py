@@ -28,7 +28,8 @@ from ... import consts, telemetry
 from ...config import ClusterConfig
 from ...netutil import Packet, PacketConnection, serve_tcp
 from ...proto import msgtypes as MT
-from ...telemetry import trace
+from ...proto.connection import METRICS_SUFFIX_VERSION
+from ...telemetry import flight, trace, tracectx
 from ...utils import binutil, gwlog, gwvar, opmon
 
 from ...consts import (  # noqa: F401  (module aliases kept for callers)
@@ -145,6 +146,11 @@ class DispatcherService:
         # instruments are no-ops while telemetry is disabled)
         self.clu_stats = {"leases": 0, "failovers": 0,
                           "fenced_packets": 0, "replayed_moves": 0}
+        # federated cluster view: component name -> last metric snapshot
+        # (lease-renew piggyback from games, MT_METRICS_REPORT from gates);
+        # re-emitted at /debug/metrics via a registry collector
+        self.cluster_metrics: dict[str, dict] = {}
+        self._metrics_lock = threading.Lock()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -153,6 +159,11 @@ class DispatcherService:
         gwvar.set_var("component", f"dispatcher{self.id}")
         if self.dispcfg.telemetry:
             telemetry.enable()
+        flight.configure(component=f"dispatcher{self.id}")
+        # the dispatcher IS the cluster aggregation point: its
+        # /debug/metrics re-emits every reported component snapshot,
+        # labeled, next to its own series
+        telemetry.register_collector(self._telemetry_collect, weak=True)
         if self.dispcfg.http_port:
             binutil.setup_http_server(self.dispcfg.http_port)
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -241,6 +252,20 @@ class DispatcherService:
         if MT.is_redirect_to_client(msgtype) or msgtype == MT.MT_SYNC_POSITION_YAW_ON_CLIENTS:
             gate_id = pkt.read_u16()
             gate = self.gates.get(gate_id)
+            if msgtype == MT.MT_SYNC_POSITION_YAW_ON_CLIENTS:
+                # downlink half of the causal trace: the game stamped the
+                # per-gate batch; strip + measure here, re-stamp hop+1 so
+                # the gate closes the loop (stride: client_id + 32B record)
+                ctx = tracectx.try_strip(pkt, stride=48)
+                if ctx is not None:
+                    tracectx.record_hop(ctx, "dispatcher.sync_down")
+                    if gate:
+                        out = Packet(bytearray(pkt.payload))
+                        if telemetry.enabled():
+                            tracectx.stamp(out, ctx.trace_id, ctx.hop + 1,
+                                           ctx.origin_ns)
+                        gate.send(out)
+                    return
             if gate:
                 gate.send_payload(pkt.payload)
             return
@@ -457,6 +482,49 @@ class DispatcherService:
         gi.spaces = spaces
         self.clu_stats["leases"] += 1
         _LEASES.inc()
+        # versioned optional suffix: a piggybacked metric snapshot.  Old
+        # senders stop at the space list (nothing remains); unknown future
+        # versions are ignored, never parsed (docs/protocol.md).
+        if pkt.remaining() > 0:
+            ver = pkt.read_u8()
+            if 1 <= ver <= METRICS_SUFFIX_VERSION:
+                self._store_metrics(f"game{gid}", pkt.read_data())
+
+    def _h_metrics_report(self, peer, pkt):
+        """Out-of-band metric snapshot (gates: no lease to piggyback on)."""
+        comp = pkt.read_varstr()
+        ver = pkt.read_u8()
+        if not 1 <= ver <= METRICS_SUFFIX_VERSION:
+            return
+        self._store_metrics(comp, pkt.read_data())
+
+    def _store_metrics(self, comp: str, snap) -> None:
+        if isinstance(snap, dict):
+            with self._metrics_lock:
+                self.cluster_metrics[comp] = snap
+
+    def _telemetry_collect(self):
+        """Registry collector: the federated cluster view.  Every reported
+        component snapshot re-emits labeled by component, so one scrape of
+        the dispatcher's /debug/metrics reads the whole cluster."""
+        with self._metrics_lock:
+            snaps = {c: dict(s) for c, s in self.cluster_metrics.items()}
+        out = [telemetry.Sample("clu.metric_sources", "gauge",
+                                float(len(snaps)),
+                                help="components reporting metric "
+                                     "snapshots to this dispatcher")]
+        for comp in sorted(snaps):
+            for key, val in sorted(snaps[comp].items()):
+                if not isinstance(val, (int, float)) \
+                        or isinstance(val, bool):
+                    continue
+                base, brace, _rest = key.partition("{")
+                labels = {"component": comp}
+                if brace:
+                    labels["series"] = key
+                out.append(telemetry.Sample(base, "gauge", float(val),
+                                            labels))
+        return out
 
     def _fence(self, peer: _Peer, msgtype: int):
         """Drop one stale-epoch packet and (once) tell the zombie to die."""
@@ -547,6 +615,12 @@ class DispatcherService:
                 self.entities[eid].game_id = survivor
             self.clu_stats["failovers"] += 1
             _FAILOVERS.inc()
+            # black-box the failover: what the dispatcher saw right up to
+            # (and including) the re-homing decision
+            flight.note("clu.failover", gid=gid, survivor=survivor,
+                        spaces=len(gi.spaces), entities=len(dead),
+                        replayed=len(buf) if buf else 0)
+            flight.dump("failover")
             self.log.info(
                 "game%d failed over to game%d: %d spaces re-homed, %d "
                 "entities re-pointed, %d move batches replayed, %d "
@@ -620,6 +694,15 @@ class DispatcherService:
     def _h_sync_from_client(self, peer, pkt):
         """Flat array of (eid, x, y, z, yaw) from a gate; regroup per game
         (reference: DispatcherService.go:789-827)."""
+        # the gate may have stamped a trace trailer (telemetry on at the
+        # origin): strip it BEFORE record parsing, record the gate->disp
+        # wire hop, and re-stamp hop+1 on every per-game packet below
+        ctx = tracectx.try_strip(pkt)
+        if ctx is not None:
+            tracectx.record_hop(ctx, "dispatcher.sync")
+            tracectx.record_local_span(ctx, "wire.hop")
+        flight.note_packet("rx", MT.MT_SYNC_POSITION_YAW_FROM_CLIENT,
+                           len(pkt.buf))
         per_game: dict[int, Packet] = {}
         while pkt.remaining() > 0:
             eid = pkt.read_entity_id()
@@ -639,11 +722,16 @@ class DispatcherService:
                 # even when delivery succeeds, because the owner may die
                 # after the send but before applying it.  The survivor
                 # dedups replay against its restored checkpoint tick.
+                # Buffered BEFORE the trace re-stamp: replay bodies stay
+                # trailer-free (the worker strips defensively anyway).
                 buf = self._move_buffer.get(gid)
                 if buf is None:
                     buf = deque(maxlen=max(1, self.dispcfg.lease_replay_cap))
                     self._move_buffer[gid] = buf
                 buf.append(bytes(out.payload))
+            if ctx is not None and telemetry.enabled():
+                tracectx.stamp(out, ctx.trace_id, ctx.hop + 1,
+                               ctx.origin_ns)
             self._send_to_game(gid, out)
 
     # -- migration ---------------------------------------------------------
@@ -880,4 +968,5 @@ class DispatcherService:
         MT.MT_CLEAR_CLIENTPROXY_FILTER_PROPS: _h_clear_filter_props,
         MT.MT_GAME_LBC_INFO: _h_game_lbc_info,
         MT.MT_GAME_LEASE_RENEW: _h_game_lease_renew,
+        MT.MT_METRICS_REPORT: _h_metrics_report,
     }

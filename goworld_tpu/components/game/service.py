@@ -20,6 +20,7 @@ import threading
 import time
 
 from ... import consts, faults, telemetry
+from ...telemetry import flight, tracectx
 from ...config import ClusterConfig
 from ...consts import COMPONENT_QUEUE_MAX
 from ...dispatchercluster import DispatcherCluster
@@ -135,6 +136,10 @@ class GameService:
         from ...storage.backends import config_kwargs as st_kwargs
 
         ck_dir = os.path.join(base_dir, "checkpoints")
+        # the flight recorder dumps into a namespace beside the durable
+        # store: the post-mortem lands where the forensics already live
+        flight.configure(dir=os.path.join(base_dir, "flight"),
+                         component=f"game{self.id}")
         store = new_entity_storage(
             self.cfg.storage.backend,
             **st_kwargs(self.cfg.storage.backend, self.cfg.storage, ck_dir))
@@ -165,6 +170,7 @@ class GameService:
             telemetry.enable(clock=self.rt.now)
         if self.gcfg.http_port:
             binutil.setup_http_server(self.gcfg.http_port)
+        flight.configure(component=f"game{self.id}")
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         opmon.start_periodic_dump(consts.OPMON_DUMP_INTERVAL_S)
@@ -272,8 +278,13 @@ class GameService:
         lease -- the dispatcher fails our spaces over and the late renewal
         is fenced as a stale epoch."""
         faults.check("clu.lease")
+        # telemetry on: the renewal piggybacks this game's metric snapshot
+        # (the versioned suffix) so the dispatcher's /debug/metrics serves
+        # the whole cluster without a second reporting channel
+        metrics = telemetry.snapshot() if telemetry.enabled() else None
         self.cluster.renew_leases(
-            self.id, self._lease_epochs, self._checkpointed_space_ids())
+            self.id, self._lease_epochs, self._checkpointed_space_ids(),
+            metrics=metrics)
 
     def step(self, n: int = 1):
         """Synchronous tick driver for tests (no background thread)."""
@@ -416,6 +427,13 @@ class GameService:
         vectorized writes -- zero per-entity Python attribute writes on
         the hot path; per-entity set_position stays for AI/logic moves
         (reference: GameService.go:398-410 flat array decode)."""
+        # trace trailer off FIRST: ingest frombuffer-decodes remaining()
+        # bytes as flat 32-byte records, and stripping must precede the
+        # memoryview it takes over pkt.buf
+        ctx = tracectx.try_strip(pkt)
+        if ctx is not None:
+            tracectx.record_hop(ctx, "game.ingest")
+            tracectx.record_local_span(ctx, "wire.hop")
         self.ingest.ingest(pkt)
 
     def _h_create_entity_anywhere(self, pkt):
@@ -759,9 +777,14 @@ class GameService:
                 p = GWConnection.make_sync_on_clients_packet(gate_id)
                 per_gate[gate_id] = p
             GWConnection.append_sync_record(p, client_id, eid, x, y, z, yaw)
+        traced = telemetry.enabled()
         for gate_id, p in per_gate.items():
             conn = self.cluster.by_gate(gate_id)
             if conn:
+                if traced:
+                    # downlink origin: each per-gate sync batch starts a
+                    # fresh trace (hop 0) the dispatcher re-stamps gateward
+                    tracectx.stamp(p, tracectx.new_trace_id(), hop=0)
                 conn.send(p)
 
     def _flush_orphan_client(self, cli: GameClient):

@@ -22,6 +22,7 @@ import threading
 import time
 
 from ... import consts, telemetry
+from ...telemetry import flight, tracectx
 from ...config import ClusterConfig
 from ...consts import COMPONENT_QUEUE_MAX
 from ...dispatchercluster import DispatcherCluster
@@ -128,6 +129,7 @@ class GateService:
         gwvar.set_var("component", f"gate{self.id}")
         if self.gatecfg.telemetry:
             telemetry.enable()
+        flight.configure(component=f"gate{self.id}")
         if self.gatecfg.http_port:
             binutil.setup_http_server(self.gatecfg.http_port)
         self.cluster.start()
@@ -204,6 +206,9 @@ class GateService:
         hb_timeout = self.gatecfg.heartbeat_timeout_s
         hb_interval = min(5.0, max(0.25, hb_timeout / 2)) if hb_timeout > 0 else 5.0
         next_hb_check = time.monotonic() + hb_interval
+        # gates hold no lease to piggyback metrics on; they push a
+        # rate-limited MT_METRICS_REPORT instead (telemetry on only)
+        next_metrics = time.monotonic() + 1.0
         while not self._stop.is_set():
             timeout = max(0.0, flush_deadline - time.monotonic())
             try:
@@ -227,6 +232,22 @@ class GateService:
                 # wall time but the LIVENESS decision rides self.now()
                 self._kick_dead_clients(self.now())
                 next_hb_check = now + hb_interval
+            if now >= next_metrics:
+                self._report_metrics()
+                next_metrics = now + 1.0
+
+    def _report_metrics(self):
+        """Push this gate's metric snapshot to every live dispatcher (the
+        federated /debug/metrics source for components without a lease)."""
+        if not telemetry.enabled():
+            return
+        snap = telemetry.snapshot()
+        for conn in self.cluster.conns:
+            if conn:
+                try:
+                    conn.send_metrics_report(f"gate{self.id}", snap)
+                except OSError:
+                    pass
 
     def _dispatch(self, kind, a, b):
         if kind == "client_pkt":
@@ -334,9 +355,19 @@ class GateService:
         self.log.warning("unexpected client msgtype %d", msgtype)
 
     def _flush_sync_batches(self):
+        # telemetry on: every flushed batch is the ORIGIN of one causal
+        # trace -- a fresh trace id at hop 0, carried as a wire trailer the
+        # dispatcher strips, measures, and re-stamps per game.  Telemetry
+        # off: nothing is appended and the bytes stay identical.
+        traced = telemetry.enabled()
         for di, batch in self._sync_batches.items():
             conn = self.cluster.conns[di]
             if conn:
+                if traced:
+                    tracectx.stamp(batch, tracectx.new_trace_id(), hop=0)
+                flight.note_packet(
+                    "tx", MT.MT_SYNC_POSITION_YAW_FROM_CLIENT,
+                    len(batch.buf))
                 conn.send(batch)
         self._sync_batches.clear()
 
@@ -367,6 +398,12 @@ class GateService:
             return
         if msgtype == MT.MT_SYNC_POSITION_YAW_ON_CLIENTS:
             _gate_id = pkt.read_u16()
+            # strip the trace trailer BEFORE the stride-48 regroup loop --
+            # the trailer is not a (client_id, record) pair
+            ctx = tracectx.try_strip(pkt, stride=48)
+            if ctx is not None:
+                tracectx.record_hop(ctx, "gate.sync_down")
+                tracectx.record_local_span(ctx, "wire.hop")
             # regroup records per client (reference: GateService.go:347-373)
             per_client: dict[str, Packet] = {}
             while pkt.remaining() > 0:

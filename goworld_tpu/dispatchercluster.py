@@ -315,20 +315,29 @@ class DispatcherCluster:
 
     # -- cluster supervision ----------------------------------------------
     def renew_leases(self, game_id: int, epochs: dict[int, int],
-                     space_ids: list[str]) -> int:
+                     space_ids: list[str],
+                     metrics: dict | None = None) -> int:
         """Send a liveness lease renewal on every connected link whose
         dispatcher has granted an epoch (docs/robustness.md "Cluster
         supervision & host failover").  Down links are skipped, NOT
         buffered into the outage replay: a renewal replayed after an
         outage would carry a pre-outage epoch and be fenced -- liveness
-        claims must be fresh or absent.  Returns the number sent."""
+        claims must be fresh or absent.  ``metrics`` piggybacks a metric
+        snapshot as the renewal's versioned suffix (docs/observability.md
+        "Cluster metrics").  Returns the number sent."""
         n = 0
         for i, conn in enumerate(self.conns):
             epoch = epochs.get(i)
             if conn is None or epoch is None:
                 continue
             try:
-                conn.send_game_lease_renew(game_id, epoch, space_ids)
+                # keep the metrics-less call shape when there is nothing
+                # to piggyback (fake connections in tests stub exactly it)
+                if metrics is None:
+                    conn.send_game_lease_renew(game_id, epoch, space_ids)
+                else:
+                    conn.send_game_lease_renew(game_id, epoch, space_ids,
+                                               metrics=metrics)
                 n += 1
             except (OSError, ConnectionResetError):
                 pass

@@ -46,6 +46,8 @@ import numpy as np
 
 from .. import faults
 from ..netutil import Packet, PacketConnection, connect_tcp
+from .. import telemetry
+from ..telemetry import flight, tracectx
 from ..proto import GWConnection, msgtypes as MT
 from .checkpoint import (CheckpointController, _open_backends,
                          _read_journal, _tick_crc, _walk_frames)
@@ -135,8 +137,17 @@ class _Worker:
         if msgtype == MT.MT_SYNC_POSITION_YAW_FROM_CLIENT:
             self._apply_sync(pkt)
             if self.epoch is not None:
-                self.conn.send_game_lease_renew(
-                    self.args.game_id, self.epoch, sorted(self.spaces))
+                # piggyback the snapshot like the real GameService does,
+                # so the parent dispatcher federates this worker's series
+                metrics = (telemetry.snapshot()
+                           if telemetry.enabled() else None)
+                if metrics is None:
+                    self.conn.send_game_lease_renew(
+                        self.args.game_id, self.epoch, sorted(self.spaces))
+                else:
+                    self.conn.send_game_lease_renew(
+                        self.args.game_id, self.epoch, sorted(self.spaces),
+                        metrics=metrics)
                 self.conn.flush()
         elif msgtype == MT.MT_GAME_LEASE_GRANT:
             self.epoch = pkt.read_u32()
@@ -162,6 +173,9 @@ class _Worker:
         dropped -- the exactly-once half of the failover argument."""
         per_space: dict[str, list] = {}
         stamp = 0
+        # defensive: the dispatcher re-stamps relayed batches with a trace
+        # trailer when telemetry is on; strip it before the stride-32 loop
+        tracectx.try_strip(pkt)
         while pkt.remaining() > 0:
             eid = pkt.read_entity_id()
             x, y, z, _yaw = _REC.unpack(pkt.read_bytes(16))
@@ -236,6 +250,11 @@ def _worker_main(argv=None) -> int:
     ap.add_argument("--journal-dir", required=True)
     args = ap.parse_args(argv)
     os.makedirs(args.journal_dir, exist_ok=True)
+    # black box beside the shared checkpoint store (GW_FLIGHT_DIR, if the
+    # harness set it, already won at import); with GW_FLIGHT_INTERVAL_S the
+    # heartbeat is what leaves a post-mortem behind after SIGKILL
+    flight.configure(dir=os.path.join(args.ckpt_dir, "flight"),
+                     component=f"game{args.game_id}")
     return _Worker(args).run()
 
 

@@ -19,6 +19,7 @@ Tick phases (order matters and is part of the engine contract):
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable
 
@@ -36,6 +37,13 @@ from .timers import TimerQueue
 # a no-op while telemetry is disabled
 _TICK_SECONDS = telemetry.histogram(
     "tick.seconds", "whole-tick wall time (timers+aoi+sync+post)")
+
+# SLO gate: a tick over this budget trips the flight recorder (0 = off).
+# Env-configured -- the budget is an ops knob, not an engine parameter.
+try:
+    _TICK_BUDGET_MS = float(os.environ.get("GW_TICK_BUDGET_MS", "0") or 0)
+except ValueError:
+    _TICK_BUDGET_MS = 0.0
 
 
 class Runtime:
@@ -183,6 +191,7 @@ class Runtime:
         self.tick_count += 1
         _trace.mark_tick(self.tick_count)
         _t0 = _trace.t()
+        _wall0 = time.perf_counter() if _TICK_BUDGET_MS > 0 else 0.0
         with _trace.span("tick.timers"):
             self.timers.tick(self.on_error)
             self.crontab.maybe_check()
@@ -210,6 +219,13 @@ class Runtime:
                 if sp._aoi_handle is not None})
             self.checkpoint.step(self.tick_count)
         _TICK_SECONDS.observe(_trace.lap("tick", _t0))
+        if _TICK_BUDGET_MS > 0:
+            _dur_ms = (time.perf_counter() - _wall0) * 1000.0
+            if _dur_ms > _TICK_BUDGET_MS:
+                from ..telemetry import flight as _flight
+
+                _flight.slo_breach(self.tick_count, _dur_ms,
+                                   _TICK_BUDGET_MS)
 
     def _aoi_phase(self):
         spaces = list(self.entities.spaces.values())

@@ -305,15 +305,25 @@ class FaultPlan:
 
     # -- firing ------------------------------------------------------------
     def _hit(self, seam: str) -> tuple[FaultSpec | None, int]:
+        entry = hit = None
         with self._lock:
             n = self.counts.get(seam, 0) + 1
             self.counts[seam] = n
             for spec in self.specs:
                 if spec.seam == seam and spec.matches(n):
-                    self.fired.append({"seam": seam, "kind": spec.kind,
-                                       "occurrence": n, "arg": spec.arg})
-                    return spec, n
-        return None, n
+                    entry = {"seam": seam, "kind": spec.kind,
+                             "occurrence": n, "arg": spec.arg}
+                    self.fired.append(entry)
+                    hit = spec
+                    break
+        if entry is not None:
+            # flight-recorder hook OUTSIDE the plan lock: a clu.* firing
+            # dumps the black box, and the dump's metric snapshot may
+            # read back through fault collectors
+            from .telemetry import flight as _flight
+
+            _flight.note_fault(entry)
+        return hit, n
 
     def check(self, seam: str) -> FaultSpec | None:
         """Count one occurrence of ``seam``; raise/stall if a spec fires.

@@ -15,6 +15,10 @@ from .. import faults
 from ..netutil import Packet, PacketConnection
 from . import msgtypes as MT
 
+# version of the optional metric-snapshot suffix (lease renew piggyback /
+# MT_METRICS_REPORT body); receivers ignore versions they don't know
+METRICS_SUFFIX_VERSION = 1
+
 
 class GWConnection:
     """A PacketConnection plus typed senders and an auto-flush thread."""
@@ -215,16 +219,36 @@ class GWConnection:
 
     # -- cluster supervision ----------------------------------------------
     def send_game_lease_renew(self, game_id: int, epoch: int,
-                              space_ids: list[str]):
+                              space_ids: list[str],
+                              metrics: dict | None = None):
         """Renew this game's liveness lease at one dispatcher, reporting the
         ownership epoch it holds and the space ids whose checkpoints it is
-        writing (the re-homing inventory if this lease ever expires)."""
+        writing (the re-homing inventory if this lease ever expires).
+
+        ``metrics`` piggybacks a telemetry snapshot as a VERSIONED optional
+        suffix (u8 version + data blob) -- old receivers see nothing (they
+        stop at the space-id list), old senders send nothing, and the
+        receiver consumes the blob only behind a version check
+        (docs/protocol.md "Versioned optional suffixes")."""
         p = Packet.for_msgtype(MT.MT_GAME_LEASE_RENEW)
         p.append_u16(game_id)
         p.append_u32(epoch)
         p.append_u32(len(space_ids))
         for sid in space_ids:
             p.append_varstr(sid)
+        if metrics is not None:
+            p.append_u8(METRICS_SUFFIX_VERSION)
+            p.append_data(metrics)
+        self.send(p)
+
+    def send_metrics_report(self, component: str, metrics: dict):
+        """Push one component's metric snapshot to a dispatcher (gates --
+        which hold no lease to piggyback on -- and any out-of-band
+        reporter).  Same versioned blob as the lease-renew suffix."""
+        p = Packet.for_msgtype(MT.MT_METRICS_REPORT)
+        p.append_varstr(component)
+        p.append_u8(METRICS_SUFFIX_VERSION)
+        p.append_data(metrics)
         self.send(p)
 
     def send_game_lease_grant(self, epoch: int, ttl: float):

@@ -34,6 +34,12 @@ MT_REHOME_SPACES = 15      # disp -> survivor game: dead gid, new epoch,
 MT_REPLAY_MOVES = 16       # disp -> survivor game: dead gid, buffered client
                            # movement batches since the last consistent epoch
 
+# -- cluster observability (docs/observability.md "Cluster metrics") -------
+MT_METRICS_REPORT = 17     # gate/game -> disp: component name, versioned
+                           # metric snapshot (games usually piggyback on
+                           # MT_GAME_LEASE_RENEW instead; gates have no
+                           # lease, so they send this)
+
 # -- entity creation / RPC routing ----------------------------------------
 MT_CREATE_ENTITY_ANYWHERE = 20  # game -> disp: type, attrs (LBC placement)
 MT_LOAD_ENTITY_ANYWHERE = 21    # game -> disp: type, eid

@@ -25,11 +25,39 @@ that cannot reach the config file).
 from __future__ import annotations
 
 import os
+import sys
 
 from . import metrics, trace
 from .metrics import HIST_BOUNDS, Counter, Gauge, Histogram, Registry, Sample
 
 _REGISTRY = Registry(enabled=False)
+
+
+def accelerator_absent() -> bool:
+    """True when this process has no TPU backend attached.  Reads
+    ``sys.modules`` instead of importing jax -- the telemetry package
+    stays jax-free, and a process that never imported jax (gates,
+    dispatchers) truthfully has no accelerator."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return True
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _accelerator_collect() -> list[Sample]:
+    # always-on (registered at import, served even with telemetry off):
+    # the "no accelerator since BENCH_r04" condition must be scrapeable
+    # from /debug/metrics, not just a stdout banner (docs/observability.md)
+    return [Sample("accelerator_absent", "gauge",
+                   1.0 if accelerator_absent() else 0.0,
+                   help="1 when this process has no TPU backend attached "
+                        "(its perf numbers are not accelerator evidence)")]
+
+
+_REGISTRY.register_collector(_accelerator_collect)
 
 
 def registry() -> Registry:
@@ -81,7 +109,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "Sample", "HIST_BOUNDS",
     "metrics", "trace", "registry", "enabled", "enable", "disable",
     "counter", "gauge", "histogram", "register_collector", "snapshot",
-    "render_prometheus",
+    "render_prometheus", "accelerator_absent",
 ]
 
 if os.environ.get("GW_TELEMETRY", "") in ("1", "true", "yes"):

@@ -63,6 +63,7 @@ class _Span:
         if factory is not None:
             self._annot = factory(self.name)
             self._annot.__enter__()
+        _active_stack().append(self.name)
         self.t0 = tr.clock()
         return self
 
@@ -71,8 +72,29 @@ class _Span:
         t1 = tr.clock()
         if self._annot is not None:
             self._annot.__exit__(None, None, None)
+        stack = _active_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
         tr.record(self.name, self.t0, t1)
         return False
+
+
+_ACTIVE = threading.local()
+
+
+def _active_stack() -> list:
+    stack = getattr(_ACTIVE, "stack", None)
+    if stack is None:
+        stack = _ACTIVE.stack = []
+    return stack
+
+
+def current_span() -> str | None:
+    """Name of the innermost open ``with span(...)`` block on this thread
+    (None when outside any span or while tracing is disabled).  Log lines
+    use it to self-locate in the tick pipeline (utils/gwlog.py)."""
+    stack = getattr(_ACTIVE, "stack", None)
+    return stack[-1] if stack else None
 
 
 class Tracer:

@@ -10,7 +10,12 @@ Endpoints (the Python analog of Go's pprof/expvar surface):
                           exposition (docs/observability.md)
   * ``/debug/trace``   -- buffered spans as Chrome trace-event JSON
                           (``?ticks=N`` windows to the last N ticks;
-                          save the body and load it in Perfetto)
+                          save the body and load it in Perfetto); carries
+                          a ``wireHops`` table so bodies from several
+                          processes merge by trace_id
+                          (``telemetry.tracectx.merge_traces``)
+  * ``/debug/flight``  -- live flight-recorder rings as JSON
+                          (docs/observability.md "Flight recorder")
   * ``/debug/stacks``  -- current stack of every thread, plain text
                           (the goroutine-dump analog of /debug/pprof)
   * ``/debug/health``  -- 200 "ok" liveness probe
@@ -27,7 +32,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from .. import telemetry
+from ..telemetry import flight as gwflight
 from ..telemetry import trace as gwtrace
+from ..telemetry import tracectx as gwtracectx
 from . import gwlog, gwvar, opmon
 
 log = gwlog.logger("binutil")
@@ -52,7 +59,13 @@ class _DebugHandler(BaseHTTPRequestHandler):
                 except ValueError:
                     self.send_error(400, "bad ticks param")
                     return
-            self._json(gwtrace.export_chrome_trace(last_ticks=ticks))
+            doc = gwtrace.export_chrome_trace(last_ticks=ticks)
+            # cross-process join key: /debug/trace bodies from several
+            # components merge by trace_id (tracectx.merge_traces)
+            doc["wireHops"] = gwtracectx.wire_hops_by_trace()
+            self._json(doc)
+        elif path == "/debug/flight":
+            self._json(gwflight.state())
         elif path == "/debug/stacks":
             self._text(_format_stacks())
         elif path in ("/debug/health", "/healthz"):

@@ -5,8 +5,13 @@ output, and a parseable readiness tag for the CLI's start barrier).
 
 ``setup(json_lines=True)`` (or ``GW_LOG_JSON=1``) switches to one JSON
 record per line -- ts/level/component/msg -- so component logs are
-machine-parseable next to /debug/metrics.  The readiness line stays
-greppable either way: ``READY_TAG`` rides inside the rendered ``msg``."""
+machine-parseable next to /debug/metrics.  When telemetry is live a line
+also carries ``span`` (the innermost open ``trace.span`` on the logging
+thread) and ``trace_id`` (the wire trace most recently handled there), so
+a cluster-wide grep for one trace id lands on every process's log lines
+for that batch (docs/observability.md "Cluster tracing").  The readiness
+line stays greppable either way: ``READY_TAG`` rides inside the rendered
+``msg``."""
 
 from __future__ import annotations
 
@@ -28,17 +33,29 @@ class _JsonLinesFormatter(logging.Formatter):
     line layout is stable for downstream parsers."""
 
     def format(self, record: logging.LogRecord) -> str:
-        return json.dumps(
-            {
-                "ts": round(record.created, 6),
-                "level": record.levelname,
-                "component": record.name,
-                "msg": record.getMessage(),
-            },
-            sort_keys=True,
-            separators=(",", ":"),
-            default=str,
-        )
+        doc = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "component": record.name,
+            "msg": record.getMessage(),
+        }
+        # tracing correlation keys, only when they exist: the active span
+        # and the wire trace id this thread last handled.  Late import --
+        # gwlog must stay importable before the telemetry package.
+        try:
+            from ..telemetry import trace as _trace
+            from ..telemetry import tracectx as _tracectx
+
+            span = _trace.current_span()
+            if span:
+                doc["span"] = span
+            tid = _tracectx.current_trace_id()
+            if tid:
+                doc["trace_id"] = tid
+        except Exception:
+            pass
+        return json.dumps(doc, sort_keys=True, separators=(",", ":"),
+                          default=str)
 
 
 def setup(level: str = "info", logfile: str | None = None,

@@ -107,7 +107,20 @@ JAX_PLATFORMS=cpu python scripts/multispace_smoke.py || fail=1
 echo "== host failover smoke =="
 JAX_PLATFORMS=cpu python scripts/host_failover_smoke.py || fail=1
 
-# 16. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
+# 16. cluster-trace smoke (CPU backend: gate + dispatcher + game as real
+#    processes, one trace id joined across their /debug/trace documents,
+#    clu.* fault -> flight-recorder auto-dump, federated /debug/metrics --
+#    docs/observability.md "Cluster tracing" / "Flight recorder")
+echo "== cluster trace smoke =="
+JAX_PLATFORMS=cpu python scripts/cluster_trace_smoke.py || fail=1
+
+# 17. bench regression gate (no backend needed: reads the BENCH_r*.json
+#    driver records and fails on a pinned per-config regression --
+#    docs/observability.md "Bench gate")
+echo "== bench gate =="
+python scripts/bench_gate.py || fail=1
+
+# 18. randomized fault-plan soak -- opt-in (GW_SOAK=1): N seedable plans
 #    over every declared seam, bit-exact parity + zero stuck buckets
 #    (GW_SOAK_ROUNDS / GW_SOAK_SEED widen the sweep; docs/robustness.md)
 if [ "${GW_SOAK:-0}" = "1" ]; then
@@ -118,7 +131,7 @@ else
     echo "== faults soak == (opt-in; GW_SOAK=1 to run)"
 fi
 
-# 17. native fan-out under ASan/UBSan -- opt-in (GW_SANITIZE=1): rebuild
+# 19. native fan-out under ASan/UBSan -- opt-in (GW_SANITIZE=1): rebuild
 #    the .san.so variants and re-run the emit-path smoke with the
 #    sanitizer runtimes preloaded (same env recipe as
 #    tests/test_native_sanitize.py; docs/perf.md emit paths)
@@ -140,7 +153,7 @@ else
     echo "== emit smoke (ASan/UBSan) == (opt-in; GW_SANITIZE=1 to run)"
 fi
 
-# 18. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
+# 20. tier-1 tests (ROADMAP.md contract: CPU backend, not-slow subset)
 echo "== tier-1 pytest =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || fail=1

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Cross-process causal-trace smoke: one trace id across a real cluster.
+
+Boots gate + dispatcher + game as three REAL processes (the component
+``__main__`` entries, telemetry on), drives client movement through the
+gate, then proves the tentpole observability claims end to end
+(docs/observability.md "Cluster tracing" / "Flight recorder"):
+
+1. a trace id stamped on a gate ingest batch shows up in the
+   dispatcher's AND the game's ``/debug/trace`` ``wireHops`` tables,
+   with different pids -- one client movement batch, one trace, three
+   processes;
+2. ``tracectx.merge_traces`` joins the per-process documents into one
+   Perfetto-loadable Chrome trace with an async row per trace id;
+3. an injected ``clu.lease`` fault (GW_FAULT_PLAN) makes the game's
+   flight recorder auto-dump, and the dump loads + renders as a Chrome
+   trace via ``python -m goworld_tpu.telemetry.flight``;
+4. the dispatcher's federated ``/debug/metrics`` serves the game's
+   piggybacked snapshot (a ``component="game1"`` series) plus the
+   always-on ``accelerator_absent`` gauge.
+"""
+
+import glob
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from goworld_tpu.telemetry import flight, tracectx  # noqa: E402
+
+GAME_SCRIPT = '''
+from goworld_tpu.engine.entity import Entity
+
+
+class Avatar(Entity):
+    use_aoi = True
+    aoi_distance = 100.0
+
+
+def setup(game):
+    game.register_entity_type(Avatar)
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_text(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode("utf-8", "replace")
+
+
+def _poll(pred, timeout: float, what: str, interval: float = 0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            v = pred()
+        except Exception:
+            v = None
+        if v:
+            return v
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main():
+    base = tempfile.mkdtemp(prefix="gw_cluster_trace_")
+    flight_dir = os.path.join(base, "flight")
+    disp_port, gate_port = _free_port(), _free_port()
+    http = {"dispatcher": _free_port(), "game": _free_port(),
+            "gate": _free_port()}
+    cfg_path = os.path.join(base, "goworld.ini")
+    with open(cfg_path, "w") as fh:
+        fh.write(f"""
+[deployment]
+dispatchers = 1
+games = 1
+gates = 1
+
+[dispatcher1]
+host = 127.0.0.1
+port = {disp_port}
+http_port = {http['dispatcher']}
+lease_ttl_s = 30.0
+telemetry = true
+
+[game_common]
+boot_entity = Avatar
+aoi_backend = cpu
+position_sync_interval_ms = 50
+http_port = {http['game']}
+telemetry = true
+
+[gate1]
+host = 127.0.0.1
+port = {gate_port}
+http_port = {http['gate']}
+heartbeat_timeout_s = 0
+telemetry = true
+
+[storage]
+backend = filesystem
+directory = {base}/entity_storage
+
+[kvdb]
+backend = filesystem
+directory = {base}/kvdb
+""")
+    script_path = os.path.join(base, "server.py")
+    with open(script_path, "w") as fh:
+        fh.write(GAME_SCRIPT)
+
+    env = {**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+           "GW_TELEMETRY": "1", "GW_FLIGHT_DIR": flight_dir}
+    # the game's 2nd lease renewal crosses a stalling clu.lease fault --
+    # a clu.* seam firing is a flight-recorder auto-dump trigger (the
+    # 10ms stall is far inside the 30s TTL: no failover, just forensics)
+    game_env = {**env, "GW_FAULT_PLAN": "clu.lease:stall@2:0.01"}
+    procs = []
+    client = None
+    try:
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "goworld_tpu.components.dispatcher",
+             "-dispid", "1", "-configfile", cfg_path],
+            env=env, cwd=base))
+        _poll(lambda: _get_text(
+            f"http://127.0.0.1:{http['dispatcher']}/debug/health") == "ok",
+            30.0, "dispatcher /debug/health")
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "goworld_tpu.components.game",
+             "-gid", "1", "-configfile", cfg_path, "-script", script_path,
+             "-dir", base],
+            env=game_env, cwd=base))
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "goworld_tpu.components.gate",
+             "-gateid", "1", "-configfile", cfg_path],
+            env=env, cwd=base))
+        for who in ("game", "gate"):
+            _poll(lambda w=who: _get_text(
+                f"http://127.0.0.1:{http[w]}/debug/health") == "ok",
+                60.0, f"{who} /debug/health")
+
+        from goworld_tpu.client import GameClientConnection
+
+        client = _poll(
+            lambda: GameClientConnection(("127.0.0.1", gate_port)),
+            30.0, "gate accepting clients")
+        assert client.wait_for(lambda c: c.player is not None, 30.0), \
+            "no boot entity"
+        # movement traffic: each gate flush cadence batches these and
+        # stamps one fresh trace id per dispatcher batch
+        for i in range(60):
+            client.send_position(10.0 + i, 0.0, 20.0 + i, 0.0)
+            time.sleep(0.02)
+
+        # 1. the same trace id crosses dispatcher -> game with two pids
+        def joined_traces():
+            docs = {w: _get_json(
+                f"http://127.0.0.1:{http[w]}/debug/trace") for w in http}
+            hops = {}
+            for doc in docs.values():
+                for tid, hl in (doc.get("wireHops") or {}).items():
+                    hops.setdefault(tid, []).extend(hl)
+            full = [tid for tid, hl in hops.items()
+                    if {"dispatcher.sync", "game.ingest"}
+                    <= {h["where"] for h in hl}
+                    and len({h["pid"] for h in hl}) >= 2]
+            return (docs, full) if full else None
+
+        docs, full = _poll(joined_traces, 60.0,
+                           "a trace id spanning dispatcher.sync+game.ingest")
+        tid = full[0]
+        print(f"cluster trace: id {tid} crossed "
+              f"{len(docs)} processes")
+
+        # 2. merged Perfetto document: async bracket + per-hop slices
+        merged = tracectx.merge_traces(list(docs.values()))
+        evs = merged["traceEvents"]
+        aid = "0x" + tid
+        assert any(e["ph"] == "b" and e.get("id") == aid for e in evs)
+        assert any(e["ph"] == "e" and e.get("id") == aid for e in evs)
+        xs = [e for e in evs if e["ph"] == "X"
+              and e["args"]["trace_id"] == tid]
+        assert len(xs) >= 2, f"expected >=2 hops for {tid}, got {len(xs)}"
+        assert len({e["pid"] for e in xs}) >= 2, "hops must span processes"
+        for e in xs:
+            assert e["ts"] >= 0.0 and e["dur"] >= 0.0
+
+        # 3. the injected clu.lease firing dumped the game's black box
+        dumps = _poll(
+            lambda: glob.glob(
+                os.path.join(flight_dir, "flight_game1_*fault_clu*")),
+            30.0, "clu.lease flight dump")
+        doc = flight.load(dumps[0])
+        assert doc["component"] == "game1"
+        assert any(f.get("seam") == "clu.lease" for f in doc["faults"]), \
+            doc["faults"]
+        chrome = flight.to_chrome(doc)
+        assert any(e.get("cat") == "fault" for e in chrome["traceEvents"])
+        # the packaged loader renders the same dump from the CLI
+        r = subprocess.run(
+            [sys.executable, "-m", "goworld_tpu.telemetry.flight",
+             dumps[0]], env=env, capture_output=True, text=True)
+        assert r.returncode == 0 and '"traceEvents"' in r.stdout, r.stderr
+
+        # 4. federated metrics: the game's piggybacked snapshot + the
+        # always-on accelerator gauge, one scrape at the dispatcher
+        text = _poll(
+            lambda: (lambda t: t if 'component="game1"' in t else None)(
+                _get_text(
+                    f"http://127.0.0.1:{http['dispatcher']}/debug/metrics")),
+            30.0, 'component="game1" series at the dispatcher')
+        assert "gw_clu_metric_sources" in text
+        assert "gw_accelerator_absent" in text
+        print("cluster trace smoke: OK -- %d merged events, flight dump %s"
+              % (len(evs), os.path.basename(dumps[0])))
+    finally:
+        if client is not None:
+            client.close()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

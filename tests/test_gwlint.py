@@ -922,6 +922,62 @@ def test_telemetry_rule_clean_catalog_and_skips_tests(tmp_path):
     assert findings == [], [f.render() for f in findings]
 
 
+WIRE_BAD = """\
+    import struct
+
+    HDR_WIRE = struct.Struct("<QB")
+
+    def read_header(tail):
+        trace_id, hop = HDR_WIRE.unpack(tail)
+        return trace_id, hop
+"""
+
+WIRE_GOOD = """\
+    import struct
+
+    HDR_WIRE = struct.Struct("<QBB")
+    HDR_WIRE_VERSION = 1
+
+    def read_header(tail):
+        trace_id, hop, ver = HDR_WIRE.unpack(tail)
+        if ver < 1 or ver > HDR_WIRE_VERSION:
+            return None
+        return trace_id, hop
+"""
+
+
+def test_telemetry_rule_flags_unversioned_wire_layout(tmp_path):
+    """A *_WIRE struct without a _VERSION constant, unpacked without a
+    version comparison, draws both wire findings: the header would be
+    interpreted field-by-field by receivers that cannot know its shape."""
+    _mk(tmp_path, {
+        "goworld_tpu/wirehdr.py": WIRE_BAD,
+        "docs/observability.md": "\n",
+        "tests/test_t.py": "assert True\n",
+    })
+    findings, _ = _run(tmp_path, [telemetry_rule.check],
+                       tests_dir=str(tmp_path / "tests"))
+    by_msg = sorted((f.path, f.line, f.message) for f in findings)
+    assert len(by_msg) == 2, by_msg
+    assert by_msg[0][:2] == ("goworld_tpu/wirehdr.py",
+                             _ln(WIRE_BAD, "HDR_WIRE = struct.Struct"))
+    assert "no HDR_WIRE_VERSION constant" in by_msg[0][2]
+    assert by_msg[1][:2] == ("goworld_tpu/wirehdr.py",
+                             _ln(WIRE_BAD, "HDR_WIRE.unpack"))
+    assert "outside a version comparison" in by_msg[1][2]
+
+
+def test_telemetry_rule_versioned_wire_layout_clean(tmp_path):
+    _mk(tmp_path, {
+        "goworld_tpu/wirehdr.py": WIRE_GOOD,
+        "docs/observability.md": "\n",
+        "tests/test_t.py": "assert True\n",
+    })
+    findings, _ = _run(tmp_path, [telemetry_rule.check],
+                       tests_dir=str(tmp_path / "tests"))
+    assert findings == [], [f.render() for f in findings]
+
+
 # -- bounded-caps ------------------------------------------------------------
 
 CAPPED = """\
